@@ -1,0 +1,75 @@
+"""easyparallellibrary_tpu — a TPU-native distributed training framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of Alibaba's
+EasyParallelLibrary (the reference at /root/reference): a few-line
+annotation API (`replicate` / `split` scopes + a typed `Config`) that turns
+a single-device model into data-/pipeline-/tensor-/expert-parallel (or
+hybrid) training, plus the runtime features the reference ships — ZeRO,
+gradient checkpointing, gradient accumulation, mixed precision, host
+offload, sharded save/restore, fused collectives, IO sharding, metric
+merging, profiling — re-architected for TPU idioms (GSPMD shardings over a
+named ICI/DCN mesh, `jax.lax` collectives, `shard_map` pipelines) and
+extended with ring-attention / Ulysses sequence parallelism which the
+reference lacks.
+
+Typical usage (reference analog: epl.init + scope annotations,
+/root/reference/README.md:40-70)::
+
+    import easyparallellibrary_tpu as epl
+
+    epl.init(epl.Config({"pipeline.num_micro_batch": 4}))
+    with epl.replicate(1):
+        ...build/apply model...
+    plan = epl.current_plan()
+    mesh = plan.build_mesh()
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from easyparallellibrary_tpu import constants
+from easyparallellibrary_tpu.config import Config
+from easyparallellibrary_tpu.constants import GraphKeys
+from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.cluster import Cluster
+from easyparallellibrary_tpu.ir import ParallelPlan, Taskgraph, current_plan
+from easyparallellibrary_tpu.strategies import (
+    ParallelStrategy, Replicate, Split, replicate, split,
+)
+
+__version__ = "0.1.0"
+
+
+def init(config: Optional[Config] = None, devices=None,
+         layout: str = "auto") -> Env:
+  """Initialize the framework (reference: epl.init, epl/__init__.py:38-51).
+
+  Resets the global Env, installs the config, and enumerates devices into a
+  :class:`Cluster`.  Unlike the reference there are no monkey-patches to
+  install and no TF server to start; multi-host bootstrap
+  (`jax.distributed.initialize`) is the launcher CLI's job.
+  """
+  env = Env.get()
+  env.init(config)
+  env.cluster = Cluster(devices=devices, layout=layout)
+  return env
+
+
+def set_default_strategy(strategy: Optional[ParallelStrategy]):
+  """Reference: epl.set_default_strategy (epl/__init__.py:53-55)."""
+  Env.get().strategy_context.set_default(strategy)
+
+
+def add_to_collection(value, key: str):
+  """Register a metric for cross-replica merging
+  (reference: epl/ir/graph.py:600-649)."""
+  Env.get().add_to_collection(value, key)
+
+
+__all__ = [
+    "Config", "Env", "Cluster", "GraphKeys", "ParallelPlan", "Taskgraph",
+    "ParallelStrategy", "Replicate", "Split", "replicate", "split",
+    "init", "set_default_strategy", "add_to_collection", "current_plan",
+    "constants",
+]

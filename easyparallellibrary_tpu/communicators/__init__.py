@@ -1,0 +1,13 @@
+from easyparallellibrary_tpu.communicators.collectives import (
+    all_gather, all_reduce, all_to_all, axis_index, axis_size, broadcast,
+    ppermute, reduce, reduce_scatter, ring_shift,
+)
+from easyparallellibrary_tpu.communicators.fusion import (
+    FusionPlan, batch_all_reduce, build_fusion_plan,
+)
+
+__all__ = [
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "broadcast",
+    "reduce", "ppermute", "ring_shift", "axis_index", "axis_size",
+    "FusionPlan", "build_fusion_plan", "batch_all_reduce",
+]

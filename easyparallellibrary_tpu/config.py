@@ -1,0 +1,331 @@
+"""Typed, frozen, environment-overridable configuration.
+
+Mirrors the semantics of the reference's config system
+(``epl/config.py``): a nested config object whose every leaf is
+
+  * typed (value coerced / validated against the default's type),
+  * settable via environment variable ``EPL_<CATEGORY>_<ATTRIBUTE>``
+    (reference: epl/config.py:283-287),
+  * overridable by a python dict passed to ``Config(...)`` with dict
+    values taking precedence over env vars (reference: epl/config.py:289-299),
+  * protected against typos — setting an unknown attribute raises
+    (reference: epl/config.py:49-53).
+
+The categories are re-designed for TPU: communication tuning maps to XLA
+collective/fusion knobs, offload targets TPU host DRAM, and a new
+``sequence`` category covers ring/Ulysses context parallelism which the
+reference lacks (SURVEY §5.7).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Tuple
+
+from easyparallellibrary_tpu import constants
+
+
+def _coerce(value: Any, default: Any, where: str) -> Any:
+  """Coerce `value` to the type of `default` (env strings included)."""
+  if default is None:
+    return value
+  typ = type(default)
+  if isinstance(value, typ) and not (typ is int and isinstance(value, bool)):
+    # bool is a subclass of int; require exact semantics for int fields.
+    if typ is bool or not isinstance(value, bool):
+      return value
+  if typ is bool:
+    if isinstance(value, str):
+      low = value.strip().lower()
+      if low in ("true", "1", "yes", "on"):
+        return True
+      if low in ("false", "0", "no", "off", ""):
+        return False
+      raise ValueError(f"{where}: cannot parse bool from {value!r}")
+    return bool(value)
+  if typ is int:
+    return int(value)
+  if typ is float:
+    return float(value)
+  if typ is str:
+    return str(value)
+  if typ in (list, tuple):
+    if isinstance(value, str):
+      items = [v for v in value.split(",") if v != ""]
+      return typ(items)
+    return typ(value)
+  raise ValueError(f"{where}: unsupported config type {typ}")
+
+
+class _Category:
+  """One nested config section; subclasses define `_fields`.
+
+  `_fields` maps attribute name → default value.  Precedence when
+  constructing: python override > env var > default.
+  """
+
+  _fields: Dict[str, Any] = {}
+  _name = ""
+
+  def __init__(self, overrides: Dict[str, Any]):
+    unknown = set(overrides) - set(self._fields)
+    if unknown:
+      raise ValueError(
+          f"Unknown config key(s) {sorted(unknown)} in category "
+          f"'{self._name}'. Valid keys: {sorted(self._fields)}")
+    for key, default in self._fields.items():
+      value = default
+      env_key = f"{constants.ENV_PREFIX}_{self._name.upper()}_{key.upper()}"
+      if env_key in os.environ:
+        value = _coerce(os.environ[env_key], default, env_key)
+      if key in overrides:
+        value = _coerce(overrides[key], default, f"{self._name}.{key}")
+      object.__setattr__(self, key, value)
+
+  def __setattr__(self, key: str, value: Any):
+    if key not in self._fields:
+      raise AttributeError(
+          f"Unknown config key '{self._name}.{key}'. "
+          f"Valid keys: {sorted(self._fields)}")
+    object.__setattr__(self, key, _coerce(value, self._fields[key],
+                                          f"{self._name}.{key}"))
+
+  def to_dict(self) -> Dict[str, Any]:
+    return {k: getattr(self, k) for k in self._fields}
+
+  def __repr__(self):
+    inner = ", ".join(f"{k}={getattr(self, k)!r}" for k in self._fields)
+    return f"{type(self).__name__}({inner})"
+
+
+class AutoParallelConfig(_Category):
+  """Automatic parallelism (reference: epl/config.py:55-60)."""
+  _name = "auto"
+  _fields = {
+      # Enable automatic pipeline-stage partitioning of a block list.
+      "auto_parallel": False,
+      # Stage search policy: balance_param | balance_flops | repeated_layers
+      # (reference policies: balance-op-num / repeated-layers / heuristic,
+      # epl/parallel/planner.py:66-112).
+      "stage_policy": "balance_param",
+  }
+
+
+class IOConfig(_Category):
+  """Input pipeline (reference: epl/config.py:62-75)."""
+  _name = "io"
+  _fields = {
+      # Shard input files/samples across data-parallel replicas
+      # (reference io_slicing: epl/parallel/graph_editor.py:116-215).
+      "slicing": False,
+      # Allow replicas to get unequal file counts (reference:
+      # fetch_slice_objects_proportion_to_local_num_replicas,
+      # epl/parallel/graph_editor.py:787-854).
+      "unbalanced_io_slicing": False,
+      "drop_last_files": False,
+      # Host-side prefetch depth for the native loader.
+      "prefetch": 2,
+      # Number of C++ reader threads (0 = python fallback).
+      "num_threads": 4,
+  }
+
+
+class CommunicationConfig(_Category):
+  """Collective tuning (reference: epl/config.py:77-101)."""
+  _name = "communication"
+  _fields = {
+      # Number of overlapping "communicators" — on TPU this maps to how many
+      # fusion buckets may be in flight concurrently (reference pool:
+      # epl/communicators/communication_pool.py:26).
+      "num_communicators": constants.DEFAULT_NUM_COMMUNICATORS,
+      # Gradient-fusion bucket size in MB (reference: 32 MB,
+      # epl/utils/constant.py:82).
+      "fusion_threshold_mb": constants.DEFAULT_FUSION_BUCKET_MB,
+      "max_splits": constants.DEFAULT_MAX_FUSION_SPLITS,
+      # Compress gradients to bf16 for the all-reduce (reference fp16
+      # compression + scale: epl/config.py:90-94).
+      "compress_dtype": "",          # "" | "bf16" | "fp16"
+      "compress_scale": 1.0,
+      # Convert sparse grads (embedding scatter) to dense before reduction
+      # (reference: sparse_as_dense, epl/parallel/hooks.py:161-167).
+      "sparse_as_dense": False,
+      # mean | sum across replicas (reference: gradients_reduce_method).
+      "gradients_reduce_method": "mean",
+  }
+
+
+class PipelineConfig(_Category):
+  """Pipeline parallelism (reference: epl/config.py:103-114)."""
+  _name = "pipeline"
+  _fields = {
+      "num_micro_batch": 1,
+      # Number of stages when auto-partitioning (reference:
+      # pipeline.num_stages consumed by planner, epl/parallel/hooks.py:129-135).
+      "num_stages": 1,
+      # Schedule policy (reference: epl/strategies/scheduler.py:120-124).
+      "strategy": constants.SCHEDULE_PREFER_BACKWARD,
+      # Interleaved (circular) pipeline: blocks per stage > 1.
+      "num_stages_per_device": 1,
+  }
+
+
+class GradientCheckpointConfig(_Category):
+  """Rematerialization (reference: epl/config.py:116-127)."""
+  _name = "gradient_checkpoint"
+  _fields = {
+      # "" (off) | "collection" (user-tagged tensors) | "auto"
+      "type": "",
+      # Stop auto-GC at this taskgraph index (reference:
+      # gradient_checkpoint.end_taskgraph).
+      "end_taskgraph": -1,
+      # Verify checkpointed grads against baseline (reference:
+      # check_gradients, epl/runtime/gc/gradient_checkpoint.py:310-325).
+      "check_gradients": False,
+  }
+
+
+class ZeroConfig(_Category):
+  """Optimizer-state / gradient sharding (reference: epl/config.py:129-138)."""
+  _name = "zero"
+  _fields = {
+      # "" (off) | "v0" (shard optimizer state) | "v1" (+ gradients)
+      "level": "",
+  }
+
+
+class OffloadConfig(_Category):
+  """Host-DRAM offload (reference: epl/config.py:140-146)."""
+  _name = "offload"
+  _fields = {
+      # "" (off) | "v0" (params+opt state live in TPU host memory)
+      "level": "",
+  }
+
+
+class AMPConfig(_Category):
+  """Mixed precision (reference: epl/config.py:148-159)."""
+  _name = "amp"
+  _fields = {
+      # "" (off) | "O1" (bf16 compute, fp32 params)
+      "level": "",
+      # Loss scale: "dynamic" | numeric string (bf16 on TPU usually
+      # needs no scaling; kept for fp16 parity, reference
+      # epl/runtime/amp/loss_scale.py).
+      "loss_scale": "dynamic",
+      "debug_log": False,
+  }
+
+
+class ClusterConfig(_Category):
+  """Device layout (reference: epl/config.py:161-172)."""
+  _name = "cluster"
+  _fields = {
+      # Reuse the same devices for split and replicate (DP×TP colocation;
+      # reference: colocate_split_and_replicate, epl/config.py:170-171).
+      "colocate_split_and_replicate": True,
+      # Prefer packing mesh axes within a host before crossing hosts
+      # (reference: device_place_prefer_intra_node, epl/cluster.py:137).
+      "device_place_prefer_intra_node": True,
+      # Explicit mesh shape override, e.g. "stage:2,data:2,model:2".
+      "mesh_shape": "",
+  }
+
+
+class OptimizerConfig(_Category):
+  """Optimizer apply tuning (reference: epl/config.py:174-179)."""
+  _name = "optimizer"
+  _fields = {
+      # Split the weight-update into N serialized groups to bound peak
+      # memory (reference: epl/runtime/optimizer_helper.py:75-128).
+      "num_apply_group": 1,
+  }
+
+
+class SequenceConfig(_Category):
+  """Sequence/context parallelism — new vs the reference (SURVEY §5.7)."""
+  _name = "sequence"
+  _fields = {
+      # "" (off) | "ring" (ring attention over seq axis) | "ulysses"
+      "parallelism": "",
+      # Size of the seq mesh axis.
+      "axis_size": 1,
+      # Block size for blockwise/ring attention.
+      "block_size": 512,
+  }
+
+
+class Config:
+  """Root configuration (reference: epl/config.py:181).
+
+  Accepts a flat dict with dotted keys (EPL style), e.g.::
+
+      Config({"pipeline.num_micro_batch": 4, "zero.level": "v1"})
+
+  or a nested dict ``{"pipeline": {"num_micro_batch": 4}}``.
+  """
+
+  _categories: Tuple[type, ...] = (
+      AutoParallelConfig, IOConfig, CommunicationConfig, PipelineConfig,
+      GradientCheckpointConfig, ZeroConfig, OffloadConfig, AMPConfig,
+      ClusterConfig, OptimizerConfig, SequenceConfig,
+  )
+
+  def __init__(self, param_dict: Dict[str, Any] | None = None):
+    by_cat: Dict[str, Dict[str, Any]] = {c._name: {} for c in self._categories}
+    for key, value in (param_dict or {}).items():
+      if isinstance(value, dict):
+        cat, sub = key, value
+        if cat not in by_cat:
+          raise ValueError(f"Unknown config category '{cat}'")
+        by_cat[cat].update(sub)
+      else:
+        if "." not in key:
+          raise ValueError(
+              f"Config key '{key}' must be '<category>.<attr>' or a nested "
+              f"dict. Categories: {sorted(by_cat)}")
+        cat, attr = key.split(".", 1)
+        if cat not in by_cat:
+          raise ValueError(f"Unknown config category '{cat}' in key '{key}'")
+        by_cat[cat][attr] = value
+    for cls in self._categories:
+      object.__setattr__(self, cls._name, cls(by_cat[cls._name]))
+    self.validate()
+
+  def __setattr__(self, key, value):
+    raise AttributeError(
+        "Config categories are fixed; set leaves like "
+        "`config.pipeline.num_micro_batch = 4` instead.")
+
+  def validate(self):
+    """Cross-field validation (reference: epl/config.py:301-305)."""
+    if self.zero.level not in ("", constants.ZERO_V0, constants.ZERO_V1):
+      raise ValueError(f"zero.level must be '', 'v0' or 'v1'; "
+                       f"got {self.zero.level!r}")
+    if self.offload.level not in ("", constants.OFFLOAD_V0):
+      raise ValueError(f"offload.level must be '' or 'v0'; "
+                       f"got {self.offload.level!r}")
+    if self.amp.level not in ("", constants.AMP_O0, constants.AMP_O1):
+      raise ValueError(f"amp.level must be '', 'O0' or 'O1'; "
+                       f"got {self.amp.level!r}")
+    if self.gradient_checkpoint.type not in (
+        "", constants.GC_COLLECTION, constants.GC_AUTO):
+      raise ValueError("gradient_checkpoint.type must be '', 'collection' "
+                       f"or 'auto'; got {self.gradient_checkpoint.type!r}")
+    if self.sequence.parallelism not in (
+        "", constants.SEQ_PARALLEL_RING, constants.SEQ_PARALLEL_ULYSSES):
+      raise ValueError("sequence.parallelism must be '', 'ring' or "
+                       f"'ulysses'; got {self.sequence.parallelism!r}")
+    if self.pipeline.num_micro_batch < 1:
+      raise ValueError("pipeline.num_micro_batch must be >= 1")
+    if self.pipeline.num_stages < 1:
+      raise ValueError("pipeline.num_stages must be >= 1")
+    if self.communication.gradients_reduce_method not in ("mean", "sum"):
+      raise ValueError("communication.gradients_reduce_method must be "
+                       "'mean' or 'sum'")
+
+  def to_dict(self) -> Dict[str, Dict[str, Any]]:
+    return {c._name: getattr(self, c._name).to_dict()
+            for c in self._categories}
+
+  def __repr__(self):
+    return f"Config({self.to_dict()})"

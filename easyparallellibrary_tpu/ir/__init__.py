@@ -1,0 +1,4 @@
+from easyparallellibrary_tpu.ir.taskgraph import Taskgraph
+from easyparallellibrary_tpu.ir.plan import ParallelPlan, current_plan
+
+__all__ = ["Taskgraph", "ParallelPlan", "current_plan"]

@@ -1,0 +1,10 @@
+from easyparallellibrary_tpu.parallel.api import (
+    TrainState, batch_sharding, create_sharded_train_state, make_train_step,
+    named_sharding, parallelize, replicated_sharding, state_shardings,
+)
+
+__all__ = [
+    "TrainState", "parallelize", "named_sharding", "replicated_sharding",
+    "batch_sharding", "state_shardings", "create_sharded_train_state",
+    "make_train_step",
+]

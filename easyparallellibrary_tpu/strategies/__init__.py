@@ -1,0 +1,9 @@
+from easyparallellibrary_tpu.strategies.base import ParallelStrategy
+from easyparallellibrary_tpu.strategies.context import StrategyContext
+from easyparallellibrary_tpu.strategies.replicate import Replicate, replicate
+from easyparallellibrary_tpu.strategies.split import Split, split
+
+__all__ = [
+    "ParallelStrategy", "StrategyContext", "Replicate", "replicate",
+    "Split", "split",
+]

@@ -1,0 +1,63 @@
+"""Strategy scope base class.
+
+Analog of the reference's ``ParallelStrategy`` context-manager
+(epl/strategies/parallel_strategy.py:28): entering pushes the strategy onto
+the process-global :class:`StrategyContext`; the *defining call site* is the
+scope's identity so that re-entering the same ``with`` statement (e.g. a
+layer loop calling the model twice, or a module applied once per microbatch
+under trace) reuses the same taskgraph rather than minting a new stage
+(reference ``_get_stack`` :48-57).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+from easyparallellibrary_tpu.env import Env
+
+
+class ParallelStrategy:
+  """Context manager recording a parallelism annotation."""
+
+  # Subclasses set this ("replicate" / "split").
+  kind = "base"
+
+  def __init__(self, device_count: Optional[int] = None, name: str = ""):
+    if device_count is not None and device_count < 1:
+      raise ValueError(f"device_count must be >= 1, got {device_count}")
+    self.device_count = device_count
+    self.name = name
+    self.identity = self._call_site_identity()
+    # Assigned by StrategyContext when first entered.
+    self.index: Optional[int] = None
+    self.taskgraph = None
+
+  @staticmethod
+  def _call_site_identity() -> str:
+    """Identity = the user frames of the defining call stack.
+
+    Mirrors the reference's stack-hash identity
+    (epl/strategies/parallel_strategy.py:48-57): frames inside this package
+    are skipped so the identity is stable for a given user call site.
+    """
+    frames = []
+    for frame in traceback.extract_stack():
+      if "easyparallellibrary_tpu" in (frame.filename or ""):
+        continue
+      frames.append(f"{frame.filename}:{frame.lineno}")
+    return "|".join(frames[-8:])
+
+  def __enter__(self):
+    # add_context returns the canonical strategy for this call site, which
+    # may be an earlier instance when the scope is re-entered — the `as`
+    # binding must see the one that owns the taskgraph.
+    return Env.get().strategy_context.add_context(self)
+
+  def __exit__(self, exc_type, exc_value, tb):
+    Env.get().strategy_context.remove_context(self)
+    return False
+
+  def __repr__(self):
+    return (f"{type(self).__name__}(device_count={self.device_count}, "
+            f"name={self.name!r}, index={self.index})")

@@ -1,0 +1,53 @@
+"""Pytree helpers (size accounting, path utilities).
+
+Plays the role of the reference's ``epl/utils/common.py`` helpers, but for
+pytrees instead of TF graph names.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaf_bytes(leaf) -> int:
+  shape = getattr(leaf, "shape", ())
+  dtype = getattr(leaf, "dtype", np.dtype("float32"))
+  return int(np.prod(shape or (1,))) * jnp.dtype(dtype).itemsize
+
+
+def tree_bytes(tree) -> int:
+  return sum(leaf_bytes(l) for l in jax.tree_util.tree_leaves(tree))
+
+
+def tree_param_count(tree) -> int:
+  return sum(int(np.prod(getattr(l, "shape", ()) or (1,)))
+             for l in jax.tree_util.tree_leaves(tree))
+
+
+def path_str(path) -> str:
+  """Render a jax key path as 'a/b/c'."""
+  parts = []
+  for p in path:
+    if hasattr(p, "key"):
+      parts.append(str(p.key))
+    elif hasattr(p, "idx"):
+      parts.append(str(p.idx))
+    elif hasattr(p, "name"):
+      parts.append(str(p.name))
+    else:
+      parts.append(str(p))
+  return "/".join(parts)
+
+
+def tree_paths_and_leaves(tree) -> List[Tuple[str, Any]]:
+  flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+  return [(path_str(path), leaf) for path, leaf in flat]
+
+
+def tree_map_with_path_str(fn: Callable[[str, Any], Any], tree):
+  return jax.tree_util.tree_map_with_path(
+      lambda path, leaf: fn(path_str(path), leaf), tree)

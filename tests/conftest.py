@@ -1,0 +1,34 @@
+"""Test harness: 8 virtual CPU devices.
+
+Mirrors the reference's test strategy (SURVEY §4): the reference fakes 8
+GPUs by monkey-patching `Cluster.available_gpus`
+(/root/reference/tests/scheduler_test.py:37-48); here we ask XLA for 8
+host-platform devices so sharding/collective logic runs for real, just on
+CPU.  Must run before jax initializes its backends.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+  os.environ["XLA_FLAGS"] = (
+      _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS already latched to the TPU plugin, so the env var alone is
+# too late — override through the config (backends are not yet initialized
+# at collection time).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_epl_env():
+  """Each test gets a fresh Env (the reference resets Env in epl.init)."""
+  yield
+  from easyparallellibrary_tpu.env import Env
+  Env.get().reset()

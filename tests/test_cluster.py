@@ -1,0 +1,68 @@
+"""Cluster / layout / mesh tests (reference analog: tests/cluster_test*.py)."""
+
+import jax
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu import constants
+
+
+def test_eight_virtual_devices():
+  assert len(jax.devices()) == 8
+
+
+def test_all_layout_pure_dp():
+  env = epl.init(layout="all")
+  mesh = env.cluster.build_mesh()
+  sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+  assert sizes[constants.DATA_AXIS] == 8
+  assert all(sizes[a] == 1 for a in mesh.axis_names
+             if a != constants.DATA_AXIS)
+
+
+def test_auto_layout_infers_data():
+  # Reference: replicas = total / Σ per-stage device_count
+  # (epl/cluster.py:150-159).
+  env = epl.init()
+  mesh = env.cluster.build_mesh(stage=2, model=2)
+  sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+  assert sizes[constants.STAGE_AXIS] == 2
+  assert sizes[constants.MODEL_AXIS] == 2
+  assert sizes[constants.DATA_AXIS] == 2
+  assert mesh.axis_names == constants.MESH_AXES
+
+
+def test_auto_layout_indivisible_raises():
+  env = epl.init()
+  with pytest.raises(ValueError):
+    env.cluster.build_mesh(stage=3)
+
+
+def test_specific_layout_from_config():
+  env = epl.init(epl.Config({"cluster.mesh_shape": "stage:2,data:2,model:2"}))
+  mesh = env.cluster.build_mesh()
+  sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+  assert (sizes[constants.STAGE_AXIS], sizes[constants.DATA_AXIS],
+          sizes[constants.MODEL_AXIS]) == (2, 2, 2)
+
+
+def test_specific_layout_bad_shape():
+  env = epl.init(epl.Config({"cluster.mesh_shape": "stage:3,data:2"}))
+  with pytest.raises(ValueError):
+    env.cluster.build_mesh()
+
+
+def test_virtual_devices_per_stage():
+  env = epl.init()
+  env.cluster.build_mesh(stage=4)
+  vds = env.cluster.virtual_devices
+  assert len(vds) == 4
+  assert all(vd.num_devices == 2 for vd in vds)
+  ids = [d.id for vd in vds for d in vd.devices]
+  assert sorted(ids) == list(range(8))
+
+
+def test_mesh_devices_unique():
+  env = epl.init()
+  mesh = env.cluster.build_mesh(model=8)
+  assert len({d.id for d in mesh.devices.flatten()}) == 8

@@ -1,0 +1,173 @@
+"""Collective wrapper + fusion tests (reference analog:
+tests/communicator_test.py)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.communicators import (
+    all_gather, all_reduce, all_to_all, batch_all_reduce, broadcast,
+    build_fusion_plan, reduce, reduce_scatter, ring_shift,
+)
+
+shard_map = jax.shard_map if hasattr(jax, "shard_map") else None
+if shard_map is None:  # pragma: no cover
+  from jax.experimental.shard_map import shard_map
+
+
+def _mesh1d(axis="data"):
+  env = epl.init()
+  return env.cluster.build_mesh()
+
+
+def _smap(fn, mesh, in_specs, out_specs):
+  return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def test_all_reduce_sum():
+  mesh = _mesh1d()
+  x = jnp.arange(8.0)
+
+  f = _smap(lambda v: all_reduce(v, "data"), mesh, P("data"), P("data"))
+  out = f(x)
+  np.testing.assert_allclose(out, jnp.full((8,), x.sum()))
+
+
+def test_all_reduce_ops():
+  mesh = _mesh1d()
+  x = jnp.arange(8.0) + 1
+
+  for op, expect in [("max", 8.0), ("min", 1.0), ("mean", 4.5)]:
+    f = _smap(lambda v, op=op: all_reduce(v, "data", op=op),
+              mesh, P("data"), P("data"))
+    np.testing.assert_allclose(f(x), jnp.full((8,), expect))
+  f = _smap(lambda v: all_reduce(v, "data", op="prod"),
+            mesh, P("data"), P("data"))
+  np.testing.assert_allclose(f(x), jnp.full((8,), float(np.prod(x))))
+
+
+def test_all_gather_and_reduce_scatter_roundtrip():
+  mesh = _mesh1d()
+  x = jnp.arange(16.0)
+
+  def body(v):
+    gathered = all_gather(v, "data")          # full vector on each shard
+    return reduce_scatter(gathered, "data")   # shard = 8 * own piece
+
+  f = _smap(body, mesh, P("data"), P("data"))
+  np.testing.assert_allclose(f(x), 8 * x)
+
+
+def test_broadcast_from_root():
+  mesh = _mesh1d()
+  x = jnp.arange(8.0)
+
+  f = _smap(lambda v: broadcast(v, "data", root=3), mesh, P("data"),
+            P("data"))
+  np.testing.assert_allclose(f(x), jnp.full((8,), 3.0))
+
+
+def test_reduce_to_root():
+  mesh = _mesh1d()
+  x = jnp.ones((8,))
+  f = _smap(lambda v: reduce(v, "data", root=2), mesh, P("data"), P("data"))
+  out = f(x)
+  np.testing.assert_allclose(out[2], 8.0)
+  assert float(jnp.sum(out)) == 8.0
+
+
+def test_ring_shift():
+  mesh = _mesh1d()
+  x = jnp.arange(8.0)
+  f = _smap(lambda v: ring_shift(v, "data", 1), mesh, P("data"), P("data"))
+  np.testing.assert_allclose(f(x), jnp.roll(x, 1))
+
+
+def test_all_to_all_reshards_rows_to_cols():
+  mesh = _mesh1d()
+  # Row-sharded [8,8] -> column-sharded [8,8]: the global data is unchanged
+  # but each rank now holds a column instead of a row.
+  x = jnp.arange(64.0).reshape(8, 8)
+
+  def body(v):  # v: [1, 8] per rank -> [8, 1] per rank
+    return all_to_all(v, "data", split_axis=1, concat_axis=0)
+
+  f = _smap(body, mesh, P("data", None), P(None, "data"))
+  np.testing.assert_allclose(f(x), x)
+
+
+def test_fusion_plan_roundtrip():
+  tree = {
+      "a": jnp.arange(5.0),
+      "b": jnp.ones((3, 4), jnp.float32),
+      "c": jnp.arange(6, dtype=jnp.int32).reshape(2, 3),
+  }
+  plan = build_fusion_plan(tree, fusion_threshold_mb=1)
+  buffers = plan.flatten(tree)
+  # int32 and float32 leaves must land in different buckets.
+  assert plan.num_buckets == 2
+  out = plan.unflatten(buffers)
+  jax.tree_util.tree_map(np.testing.assert_allclose, out, tree)
+
+
+def test_fusion_bucket_size_split():
+  # 3 leaves of 1 MB with a 2 MB threshold -> 2 buckets.
+  mb = 1024 * 1024 // 4
+  tree = [jnp.zeros((mb,)), jnp.zeros((mb,)), jnp.zeros((mb,))]
+  plan = build_fusion_plan(tree, fusion_threshold_mb=2)
+  assert plan.num_buckets == 2
+
+
+def test_fusion_max_splits_cap():
+  tree = [jnp.zeros((1024 * 1024 // 4,)) for _ in range(8)]
+  plan = build_fusion_plan(tree, fusion_threshold_mb=1, max_splits=3)
+  assert plan.num_buckets <= 3
+
+
+def test_batch_all_reduce_matches_per_leaf():
+  mesh = _mesh1d()
+  tree = {
+      "w": jnp.arange(16.0).reshape(8, 2),
+      "b": jnp.arange(8.0),
+  }
+
+  def fused(t):
+    return batch_all_reduce(t, "data")
+
+  def perleaf(t):
+    return jax.tree_util.tree_map(lambda v: all_reduce(v, "data"), t)
+
+  spec = {"w": P("data", None), "b": P("data")}
+  f1 = _smap(fused, mesh, (spec,), spec)
+  f2 = _smap(perleaf, mesh, (spec,), spec)
+  jax.tree_util.tree_map(np.testing.assert_allclose, f1(tree), f2(tree))
+
+
+def test_batch_all_reduce_compressed():
+  mesh = _mesh1d()
+  tree = {"w": jnp.ones((8, 4)) * 0.5}
+  spec = {"w": P("data", None)}
+  f = _smap(functools.partial(batch_all_reduce, axis_name="data",
+                              compress_dtype="bf16", compress_scale=1.0),
+            mesh, (spec,), spec)
+  np.testing.assert_allclose(f(tree)["w"], jnp.full((8, 4), 4.0), rtol=1e-2)
+
+
+def test_fusion_zero_element_leaf():
+  # A shape-(0,) leaf must not corrupt bucket offsets.
+  tree = {"a": jnp.zeros((0,)), "b": jnp.arange(4.0), "c": jnp.ones(())}
+  plan = build_fusion_plan(tree)
+  out = plan.unflatten(plan.flatten(tree))
+  jax.tree_util.tree_map(np.testing.assert_allclose, out, tree)
+
+
+def test_fusion_cap_converges_exactly():
+  mb = 1024 * 1024 // 4
+  tree = [jnp.zeros((mb,)) for _ in range(8)]
+  plan = build_fusion_plan(tree, fusion_threshold_mb=1, max_splits=7)
+  assert plan.num_buckets == 7

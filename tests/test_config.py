@@ -1,0 +1,81 @@
+"""Config system tests (reference analog: tests/config_test.py —
+precedence, typing, typo rejection)."""
+
+import os
+
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.config import Config
+
+
+def test_defaults():
+  c = Config()
+  assert c.pipeline.num_micro_batch == 1
+  assert c.communication.fusion_threshold_mb == 32
+  assert c.communication.num_communicators == 2
+  assert c.zero.level == ""
+  assert c.cluster.colocate_split_and_replicate is True
+
+
+def test_dotted_overrides():
+  c = Config({"pipeline.num_micro_batch": 4, "zero.level": "v1"})
+  assert c.pipeline.num_micro_batch == 4
+  assert c.zero.level == "v1"
+
+
+def test_nested_overrides():
+  c = Config({"pipeline": {"num_micro_batch": 8, "num_stages": 2}})
+  assert c.pipeline.num_micro_batch == 8
+  assert c.pipeline.num_stages == 2
+
+
+def test_env_var_overrides_default_but_dict_wins(monkeypatch):
+  # Reference precedence: python dict > env var > default
+  # (epl/config.py:289-299).
+  monkeypatch.setenv("EPL_PIPELINE_NUM_MICRO_BATCH", "16")
+  c = Config()
+  assert c.pipeline.num_micro_batch == 16
+  c2 = Config({"pipeline.num_micro_batch": 2})
+  assert c2.pipeline.num_micro_batch == 2
+
+
+def test_env_var_bool_coercion(monkeypatch):
+  monkeypatch.setenv("EPL_IO_SLICING", "true")
+  assert Config().io.slicing is True
+  monkeypatch.setenv("EPL_IO_SLICING", "0")
+  assert Config().io.slicing is False
+
+
+def test_unknown_key_rejected():
+  # Reference: __setattr__ rejects unknown keys (epl/config.py:49-53).
+  with pytest.raises(ValueError):
+    Config({"pipeline.num_micro_batches": 4})  # typo'd key
+  with pytest.raises(ValueError):
+    Config({"nonexistent.thing": 1})
+  c = Config()
+  with pytest.raises(AttributeError):
+    c.pipeline.num_micro_batchs = 4
+
+
+def test_setattr_type_coercion():
+  c = Config()
+  c.pipeline.num_micro_batch = "8"
+  assert c.pipeline.num_micro_batch == 8
+
+
+def test_validation():
+  with pytest.raises(ValueError):
+    Config({"zero.level": "v2"})  # v2 unimplemented in the reference too
+  with pytest.raises(ValueError):
+    Config({"amp.level": "O3"})
+  with pytest.raises(ValueError):
+    Config({"pipeline.num_micro_batch": 0})
+  with pytest.raises(ValueError):
+    Config({"sequence.parallelism": "rings"})
+
+
+def test_categories_frozen():
+  c = Config()
+  with pytest.raises(AttributeError):
+    c.pipeline = None

@@ -1,0 +1,139 @@
+"""Strategy scope / context / plan tests (reference analog:
+tests/strategy_test.py, tests/strategy_context_test.py)."""
+
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.env import Env
+
+
+def test_replicate_scope_records_taskgraph():
+  epl.init()
+  with epl.replicate(1) as r:
+    assert Env.get().strategy_context.current is r
+  ctx = Env.get().strategy_context
+  assert len(ctx.taskgraphs) == 1
+  assert ctx.taskgraphs[0].kind == "replicate"
+  assert ctx.current is None
+
+
+def test_consecutive_replicates_become_stages():
+  # Reference: consecutive named replicate scopes are pipeline stages
+  # (epl/strategies/replicate.py).
+  epl.init()
+  with epl.replicate(1):
+    pass
+  with epl.replicate(1):
+    pass
+  plan = epl.current_plan()
+  assert len(plan.replicate_taskgraphs) == 2
+  assert plan.num_stages == 2
+  assert plan.pipeline_enabled
+
+
+def test_loop_reentry_reuses_taskgraph():
+  # Re-entering the same `with` statement (layer loop / retrace) must not
+  # mint a new stage (reference call-stack identity,
+  # epl/strategies/parallel_strategy.py:48-57).
+  epl.init()
+  for _ in range(3):
+    with epl.replicate(1):
+      pass
+  assert len(Env.get().strategy_context.taskgraphs) == 1
+
+
+def test_split_records_model_parallel():
+  epl.init()
+  with epl.split(4):
+    pass
+  plan = epl.current_plan()
+  assert plan.model_parallel == 4
+  assert len(plan.split_taskgraphs) == 1
+
+
+def test_nesting_rules():
+  # Reference: epl/strategies/strategy_context.py:34-54.
+  epl.init()
+  with pytest.raises(ValueError):
+    with epl.replicate(1):
+      with epl.replicate(1):
+        pass
+  epl.init()
+  with pytest.raises(ValueError):
+    with epl.replicate(1):
+      with epl.split(2):
+        pass
+  epl.init()
+  with epl.split(2):
+    with epl.split(2) as inner:   # nested split tolerated, marked nested
+      assert inner.is_nested
+
+
+def test_default_strategy():
+  epl.init()
+  epl.set_default_strategy(epl.replicate(1))
+  ctx = Env.get().strategy_context
+  assert ctx.current is not None
+  assert ctx.current.kind == "replicate"
+  assert len(ctx.taskgraphs) == 1
+
+
+def test_plan_mesh_request_and_build():
+  epl.init(epl.Config({"pipeline.num_micro_batch": 2}))
+  with epl.replicate(1):
+    pass
+  with epl.replicate(1):
+    pass
+  plan = epl.current_plan()
+  mesh = plan.build_mesh()
+  sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+  assert sizes["stage"] == 2
+  assert sizes["data"] == 4
+  assert plan.num_micro_batch == 2
+  # Taskgraphs got their virtual devices.
+  assert all(t.virtual_device is not None for t in plan.replicate_taskgraphs)
+
+
+def test_auto_parallel_stage_count_from_config():
+  epl.init(epl.Config({"auto.auto_parallel": True,
+                       "pipeline.num_stages": 4}))
+  with epl.replicate(1):
+    pass
+  plan = epl.current_plan()
+  assert plan.num_stages == 4
+
+
+def test_device_count_validation():
+  with pytest.raises(ValueError):
+    epl.replicate(0)
+
+
+def test_scope_reentry_as_binding_is_canonical():
+  epl.init()
+  seen = []
+  for _ in range(2):
+    with epl.replicate(1) as r:
+      seen.append(r)
+      r.taskgraph.add_param_prefix("blk")   # must not crash on re-entry
+  assert seen[0] is seen[1]
+  assert seen[0].taskgraph is not None
+
+
+def test_mesh_shape_conflict_with_scopes_raises():
+  epl.init(epl.Config({"cluster.mesh_shape": "data:8"}))
+  with epl.replicate(1):
+    pass
+  with epl.replicate(1):
+    pass
+  with pytest.raises(ValueError):
+    epl.current_plan().build_mesh()
+
+
+def test_split_none_takes_whole_model_axis():
+  epl.init()
+  with epl.split():
+    pass
+  plan = epl.current_plan()
+  assert plan.model_parallel == 8
+  mesh = plan.build_mesh()
+  assert dict(zip(mesh.axis_names, mesh.devices.shape))["model"] == 8
