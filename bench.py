@@ -1,0 +1,127 @@
+"""Benchmark harness — flagship GPT training step on real hardware.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numeric baselines (BASELINE.md: published == {});
+its north star for this framework is >=40% MFU on GPT-family training
+(BASELINE.json).  `vs_baseline` is therefore achieved_MFU / 0.40.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.models import GPT, GPTConfig
+from easyparallellibrary_tpu.models.gpt import gpt_flops_per_token, gpt_loss
+from easyparallellibrary_tpu.parallel import (
+    TrainState, create_sharded_train_state, make_train_step, parallelize)
+
+# Peak bf16 FLOP/s per chip by device kind.
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def peak_flops_per_chip() -> float:
+  kind = jax.devices()[0].device_kind
+  for name, flops in PEAK_FLOPS.items():
+    if kind.startswith(name):
+      return flops
+  return 197e12  # conservative default
+
+
+def main():
+  n_chips = len(jax.devices())
+  on_tpu = jax.devices()[0].platform == "tpu"
+
+  if on_tpu:
+    cfg = GPTConfig(vocab_size=32768, num_layers=24, num_heads=16,
+                    d_model=1024, d_ff=4096, max_seq_len=1024,
+                    dtype=jnp.bfloat16, remat=True, remat_policy="dots")
+    batch_size, steps, warmup = 8, 10, 2
+  else:  # smoke mode off-TPU
+    cfg = GPTConfig(vocab_size=512, num_layers=2, num_heads=4, d_model=128,
+                    d_ff=512, max_seq_len=128, dtype=jnp.float32)
+    batch_size, steps, warmup = 8, 3, 1
+
+  env = epl.init()
+  with epl.replicate(1):
+    model = GPT(cfg)
+  mesh = epl.current_plan().build_mesh()
+
+  seq = cfg.max_seq_len
+  rng = jax.random.PRNGKey(0)
+  ids = jnp.asarray(
+      np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                       (batch_size, seq + 1)), jnp.int32)
+  batch = {"ids": ids}
+  tx = optax.adamw(3e-4, weight_decay=0.01)
+
+  def init_fn(r):
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=model.init(r, ids[:, :-1])["params"], tx=tx)
+
+  state, shardings = create_sharded_train_state(init_fn, mesh, rng)
+  step = parallelize(
+      make_train_step(lambda p, b, r: gpt_loss(model, p, b, r)),
+      mesh, shardings)
+
+  # NOTE: on the remote-relay TPU backend `block_until_ready` returns
+  # before execution finishes; only a device_get of a value that depends on
+  # the whole chain forces it.  Time N chained steps, fetch the final loss
+  # scalar, and subtract the measured null round-trip.
+  for _ in range(warmup):
+    state, metrics = step(state, batch, rng)
+  float(jax.device_get(metrics["loss"]))
+
+  tiny = jax.jit(lambda v: v + 1)
+  float(jax.device_get(tiny(jnp.float32(0))))
+  t0 = time.perf_counter()
+  float(jax.device_get(tiny(jnp.float32(1))))
+  null_rt = time.perf_counter() - t0
+
+  t0 = time.perf_counter()
+  for _ in range(steps):
+    state, metrics = step(state, batch, rng)
+  float(jax.device_get(metrics["loss"]))
+  dt = max(time.perf_counter() - t0 - null_rt, 1e-9)
+
+  tokens_per_step = batch_size * seq
+  tokens_per_sec = tokens_per_step * steps / dt
+  flops_per_token = gpt_flops_per_token(cfg, seq)
+  achieved = tokens_per_sec * flops_per_token / n_chips
+  mfu = achieved / peak_flops_per_chip() if on_tpu else 0.0
+
+  result = {
+      "metric": "gpt350m_train_mfu" if on_tpu else "gpt_smoke_tokens_per_sec",
+      "value": round(mfu, 4) if on_tpu else round(tokens_per_sec, 1),
+      "unit": "mfu" if on_tpu else "tokens/sec",
+      "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 1.0,
+      "detail": {
+          "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
+          "step_time_ms": round(1000 * dt / steps, 2),
+          "n_chips": n_chips,
+          "device": jax.devices()[0].device_kind,
+          "loss": round(float(metrics["loss"]), 4),
+      },
+  }
+  print(json.dumps(result))
+
+
+if __name__ == "__main__":
+  main()

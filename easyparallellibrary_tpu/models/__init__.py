@@ -1,0 +1,3 @@
+from easyparallellibrary_tpu.models.gpt import GPT, GPTConfig
+
+__all__ = ["GPT", "GPTConfig"]

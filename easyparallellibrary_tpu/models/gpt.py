@@ -1,0 +1,219 @@
+"""GPT — the flagship decoder-only transformer family.
+
+The reference keeps its model zoo in the external FastNN repo
+(/root/reference/README.md:18); this framework bundles the models because
+the benchmark matrix (BASELINE.md configs 2/4/5) needs them.  The model is
+written TPU-first:
+
+  * bf16 compute / fp32 params by default (MXU-friendly),
+  * every weight carries GSPMD partitioning metadata: Megatron-style
+    tensor parallelism over the ``model`` axis (QKV/MLP-in column, proj/
+    MLP-out row, vocab-sharded embedding + tied head),
+  * activation sharding constraints over ``(data, seq)`` so sequence/
+    context parallelism composes,
+  * optional `jax.checkpoint` per block (gradient checkpointing),
+  * optional MoE blocks (expert parallelism) — see models/moe.py,
+  * blocks can be stacked + scanned for pipeline parallelism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from easyparallellibrary_tpu import constants
+from easyparallellibrary_tpu.ops import Dense, Embedding
+from easyparallellibrary_tpu.ops.losses import (
+    distributed_sparse_softmax_cross_entropy_with_logits,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+  vocab_size: int = 32768
+  num_layers: int = 12
+  num_heads: int = 16
+  d_model: int = 1024
+  d_ff: int = 4096
+  max_seq_len: int = 1024
+  dtype: Any = jnp.bfloat16
+  param_dtype: Any = jnp.float32
+  tensor_parallel: bool = False      # shard weights over the model axis
+  remat: bool = False                # jax.checkpoint every block
+  remat_policy: str = "nothing"      # nothing | dots | everything
+  tie_embeddings: bool = True
+  z_loss: float = 0.0
+  # MoE (expert parallelism): every `moe_every`-th block uses experts.
+  num_experts: int = 0
+  moe_every: int = 2
+  capacity_factor: float = 1.25
+  # Sequence parallelism: constrain activations over the seq axis.
+  seq_parallel: bool = False
+  attn_impl: str = "xla"             # xla | pallas_flash | ring
+
+
+def _act_spec(cfg: GPTConfig, ndim: int = 3) -> P:
+  seq = constants.SEQ_AXIS if cfg.seq_parallel else None
+  if ndim == 3:
+    return P(constants.DATA_AXIS, seq, None)
+  return P(constants.DATA_AXIS, seq)
+
+
+def _constrain(x, spec: P):
+  try:
+    return jax.lax.with_sharding_constraint(x, spec)
+  except Exception:
+    return x
+
+
+class CausalSelfAttention(nn.Module):
+  cfg: GPTConfig
+
+  @nn.compact
+  def __call__(self, x):
+    cfg = self.cfg
+    B, S, D = x.shape
+    H = cfg.num_heads
+    head_dim = D // H
+    col = "column" if cfg.tensor_parallel else "none"
+    row = "row" if cfg.tensor_parallel else "none"
+
+    qkv = Dense(3 * D, parallel=col, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="qkv")(x)
+    qkv = qkv.reshape(B, S, 3, H, head_dim)
+    # Heads ride the model axis (column-parallel QKV already produced the
+    # sharded feature dim; this re-expresses it on the head dim).
+    qkv = _constrain(qkv, P(constants.DATA_AXIS, None, None,
+                            constants.MODEL_AXIS, None))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    if cfg.attn_impl == "ring" and cfg.seq_parallel:
+      from easyparallellibrary_tpu.sequence.ring_attention import (
+          ring_attention)
+      out = ring_attention(q, k, v, causal=True)
+    elif cfg.attn_impl == "pallas_flash":
+      from easyparallellibrary_tpu.kernels.flash_attention import (
+          flash_attention)
+      out = flash_attention(q, k, v, causal=True)
+    else:
+      scale = 1.0 / jnp.sqrt(head_dim).astype(cfg.dtype)
+      logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+      mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+      logits = jnp.where(mask[None, None], logits,
+                         jnp.asarray(-1e9, logits.dtype))
+      probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+      out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
+
+    out = out.reshape(B, S, D)
+    out = Dense(D, parallel=row, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="proj")(out)
+    return _constrain(out, _act_spec(cfg))
+
+
+class MLP(nn.Module):
+  cfg: GPTConfig
+
+  @nn.compact
+  def __call__(self, x):
+    cfg = self.cfg
+    col = "column" if cfg.tensor_parallel else "none"
+    row = "row" if cfg.tensor_parallel else "none"
+    h = Dense(cfg.d_ff, parallel=col, dtype=cfg.dtype,
+              param_dtype=cfg.param_dtype, name="wi")(x)
+    h = nn.gelu(h)
+    h = Dense(cfg.d_model, parallel=row, dtype=cfg.dtype,
+              param_dtype=cfg.param_dtype, name="wo")(h)
+    return _constrain(h, _act_spec(cfg))
+
+
+class Block(nn.Module):
+  cfg: GPTConfig
+  use_moe: bool = False
+
+  @nn.compact
+  def __call__(self, x):
+    cfg = self.cfg
+    y = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+    x = x + CausalSelfAttention(cfg, name="attn")(y)
+    y = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+    if self.use_moe:
+      from easyparallellibrary_tpu.models.moe import MoEMLP
+      x = x + MoEMLP(cfg, name="moe")(y)
+    else:
+      x = x + MLP(cfg, name="mlp")(y)
+    return _constrain(x, _act_spec(cfg))
+
+
+def _remat_policy(name: str):
+  if name == "dots":
+    return jax.checkpoint_policies.checkpoint_dots
+  if name == "everything":
+    return jax.checkpoint_policies.nothing_saveable
+  return None
+
+
+class GPT(nn.Module):
+  """Decoder-only LM.  `__call__(ids) -> logits`; `loss(params-free)` via
+  :func:`gpt_loss`."""
+
+  cfg: GPTConfig
+
+  @nn.compact
+  def __call__(self, ids):
+    cfg = self.cfg
+    B, S = ids.shape
+    tok = Embedding(cfg.vocab_size, cfg.d_model,
+                    parallel="vocab" if cfg.tensor_parallel else "none",
+                    param_dtype=cfg.param_dtype, name="wte")
+    pos_init = nn.initializers.normal(stddev=0.02)
+    pos = self.param("wpe", pos_init, (cfg.max_seq_len, cfg.d_model),
+                     cfg.param_dtype)
+    x = tok(ids).astype(cfg.dtype) + pos[None, :S].astype(cfg.dtype)
+    x = _constrain(x, _act_spec(cfg))
+
+    block_cls = Block
+    if cfg.remat:
+      block_cls = nn.checkpoint(
+          Block, policy=_remat_policy(cfg.remat_policy),
+          prevent_cse=False)
+    for i in range(cfg.num_layers):
+      use_moe = cfg.num_experts > 0 and (i % cfg.moe_every == 1)
+      x = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x)
+
+    x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+    if cfg.tie_embeddings:
+      logits = tok.attend(x)
+    else:
+      logits = Dense(cfg.vocab_size,
+                     parallel="column" if cfg.tensor_parallel else "none",
+                     use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="lm_head")(x)
+    return logits
+
+
+def gpt_loss(model: GPT, params, batch, rng=None):
+  """Next-token cross entropy; batch = {"ids": [B, S+1] int32}."""
+  ids = batch["ids"]
+  inputs, targets = ids[:, :-1], ids[:, 1:]
+  logits = model.apply({"params": params}, inputs)
+  loss = distributed_sparse_softmax_cross_entropy_with_logits(
+      targets, logits.astype(jnp.float32), z_loss=model.cfg.z_loss)
+  return jnp.mean(loss), {}
+
+
+def gpt_flops_per_token(cfg: GPTConfig, seq_len: Optional[int] = None) -> float:
+  """Training FLOPs/token (fwd+bwd ≈ 3x fwd): 6*N_dense + attention term."""
+  S = seq_len or cfg.max_seq_len
+  D, F, L, V = cfg.d_model, cfg.d_ff, cfg.num_layers, cfg.vocab_size
+  per_layer = 4 * D * D + 2 * D * F   # qkv+proj, mlp in+out (matmul weights)
+  if cfg.num_experts > 0:
+    # MoE layers activate one expert per token (top-1) — same matmul count.
+    pass
+  n_matmul = L * per_layer + D * V    # + lm head
+  attn = L * 2 * D * S                # qk^T and attn*v per token
+  return 6.0 * n_matmul + 6.0 * attn

@@ -1,0 +1,23 @@
+from easyparallellibrary_tpu.ops.layers import Dense, Embedding
+from easyparallellibrary_tpu.ops.losses import (
+    distributed_sparse_softmax_cross_entropy_with_logits,
+)
+from easyparallellibrary_tpu.ops.distributed_ops import (
+    distributed_argmax, distributed_equal,
+)
+from easyparallellibrary_tpu.ops.bridging import (
+    replica_to_split, split_to_replica,
+)
+from easyparallellibrary_tpu.ops.initializers import (
+    glorot_normal_full_fan, glorot_uniform_full_fan,
+)
+from easyparallellibrary_tpu.ops.adamw import adam_weight_decay_optimizer
+
+__all__ = [
+    "Dense", "Embedding",
+    "distributed_sparse_softmax_cross_entropy_with_logits",
+    "distributed_argmax", "distributed_equal",
+    "replica_to_split", "split_to_replica",
+    "glorot_uniform_full_fan", "glorot_normal_full_fan",
+    "adam_weight_decay_optimizer",
+]

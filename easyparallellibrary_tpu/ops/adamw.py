@@ -1,0 +1,43 @@
+"""AdamW with weight-decay exclusion by name pattern.
+
+TPU-native analog of the reference's
+``AdamWeightDecayOptimizer`` (epl/ops/adam_weight_decay_optimizer.py:35):
+standard AdamW where parameters matching ``exclude_from_weight_decay``
+regexes (LayerNorm, biases) skip decay.  Built on optax with a pytree-path
+mask instead of a TF variable-name regex walk.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence
+
+import jax
+import optax
+
+from easyparallellibrary_tpu.utils.pytree import tree_map_with_path_str
+
+
+def adam_weight_decay_optimizer(
+    learning_rate,
+    weight_decay_rate: float = 0.01,
+    beta_1: float = 0.9,
+    beta_2: float = 0.999,
+    epsilon: float = 1e-6,
+    exclude_from_weight_decay: Optional[Sequence[str]] = (
+        "layer_norm", "LayerNorm", "layernorm", "bias", "scale"),
+) -> optax.GradientTransformation:
+  """Reference defaults mirrored from
+  epl/ops/adam_weight_decay_optimizer.py:35-60."""
+  patterns = [re.compile(p) for p in (exclude_from_weight_decay or [])]
+
+  def decay_mask(params):
+    return tree_map_with_path_str(
+        lambda path, _: not any(p.search(path) for p in patterns), params)
+
+  return optax.adamw(
+      learning_rate=learning_rate,
+      b1=beta_1, b2=beta_2, eps=epsilon,
+      weight_decay=weight_decay_rate,
+      mask=decay_mask,
+  )

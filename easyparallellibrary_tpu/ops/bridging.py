@@ -1,0 +1,36 @@
+"""Bridging between replicate and split layouts.
+
+TPU-native analog of the reference's bridging layers
+(epl/ops/bridging_layer.py): ``Replica2Split`` there allgathers replica
+activations onto the split devices (:46-58); ``Replica2Replica`` and
+``Split2Split`` are declared but unimplemented (:36-43).
+
+Under GSPMD a "bridge" is just a resharding constraint — XLA materializes
+the allgather/slice.  Both directions are implemented.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from easyparallellibrary_tpu import constants
+
+
+def _apply(x, spec: P):
+  try:
+    return jax.lax.with_sharding_constraint(x, spec)
+  except Exception:
+    return x
+
+
+def replica_to_split(x, dim: int = -1):
+  """Enter a tensor-parallel region: shard `dim` over the model axis."""
+  spec = [None] * x.ndim
+  spec[dim if dim >= 0 else x.ndim + dim] = constants.MODEL_AXIS
+  return _apply(x, P(*spec))
+
+
+def split_to_replica(x):
+  """Leave a tensor-parallel region: gather to replicated layout."""
+  return _apply(x, P(*([None] * x.ndim)))

@@ -1,0 +1,35 @@
+"""Distributed prediction ops.
+
+TPU-native analog of the reference's ``distributed_argmax`` /
+``distributed_equal`` (epl/ops/distributed_ops.py:98,125): the reference
+does a two-level argmax — local argmax per shard, allgather of (value,
+index) pairs, then a global argmax with shard-offset correction (:58-95).
+GSPMD compiles the same dataflow from a plain ``argmax`` over a
+vocab-sharded logical array.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from easyparallellibrary_tpu import constants
+
+
+def distributed_argmax(logits, axis: int = -1):
+  """Argmax over (possibly vocab-sharded) logits."""
+  spec = [None] * logits.ndim
+  spec[axis if axis >= 0 else logits.ndim + axis] = constants.MODEL_AXIS
+  try:
+    logits = jax.lax.with_sharding_constraint(logits, P(*spec))
+  except Exception:
+    pass
+  return jnp.argmax(logits, axis=axis)
+
+
+def distributed_equal(predictions, labels):
+  """Elementwise equality between replicated labels and (possibly
+  shard-derived) predictions (reference bridges labels to the split
+  devices via Replica2Split, epl/ops/distributed_ops.py:125-148)."""
+  return jnp.equal(predictions.astype(jnp.int32), labels.astype(jnp.int32))

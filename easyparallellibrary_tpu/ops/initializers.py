@@ -1,0 +1,45 @@
+"""Distributed initializers.
+
+The reference needs special Glorot variants because each tensor-parallel
+shard is a *separate smaller variable*, so vanilla initializers would use
+the shard's fan-in/fan-out instead of the full layer's
+(epl/ops/initializers.py:26-60).
+
+Under GSPMD this problem disappears: parameters keep their full logical
+shape (sharding is metadata), so standard initializers already see the
+correct fan.  These helpers exist for API parity and for the rare case of
+initializing a *physically* sharded buffer inside `shard_map`, where
+`full_fan_in/out` restore the reference semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def glorot_uniform_full_fan(full_fan_in: int = 0, full_fan_out: int = 0):
+  """Glorot uniform using explicitly-given full fan values."""
+
+  def init(key, shape, dtype=jnp.float32):
+    fan_in = full_fan_in or (int(np.prod(shape[:-1])) if len(shape) > 1
+                             else shape[0])
+    fan_out = full_fan_out or shape[-1]
+    limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+  return init
+
+
+def glorot_normal_full_fan(full_fan_in: int = 0, full_fan_out: int = 0):
+  """Glorot normal using explicitly-given full fan values."""
+
+  def init(key, shape, dtype=jnp.float32):
+    fan_in = full_fan_in or (int(np.prod(shape[:-1])) if len(shape) > 1
+                             else shape[0])
+    fan_out = full_fan_out or shape[-1]
+    std = float(np.sqrt(2.0 / (fan_in + fan_out)))
+    return std * jax.random.normal(key, shape, dtype)
+
+  return init

@@ -1,0 +1,170 @@
+"""Split-scope-aware layers — the tensor-parallel op library.
+
+TPU-native redesign of the reference's distributed op library
+(epl/ops/distributed_dense.py, and the hook that swaps ``tf.layers.dense``
+for it inside a ``split`` scope, epl/parallel/hooks.py:710-828).  Two
+deliberate differences:
+
+  * No monkey-patching: these are ordinary flax modules that *consult the
+    ambient strategy scope at trace time*.  Because JAX traces the model
+    function as Python, a ``with epl.split(...):`` around the layer call in
+    ``__call__`` plays exactly the role the reference's graph-construction
+    scope plays in TF1 graph mode.
+  * No uneven shards: the reference gives shard 0 the remainder
+    (epl/ops/distributed_dense.py:102-109, parallel/ops.py:507-523);
+    GSPMD wants even tiling, so feature dims must divide the mesh axis —
+    validated here with a clear error instead of silent remainder logic.
+
+Sharding layouts (Megatron-style, expressed as GSPMD metadata):
+  * column parallel: kernel P(None, "model") → activations sharded on the
+    feature dim; the reference's ``distributed_dense`` kernel
+    ``[in, units/num_shards]`` per device (:139-143).
+  * row parallel: kernel P("model", None) → XLA inserts the psum the
+    reference would build by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from easyparallellibrary_tpu import constants
+from easyparallellibrary_tpu.env import Env
+
+Dtype = Any
+default_kernel_init = nn.initializers.lecun_normal()
+
+
+def _active_split():
+  """The innermost active split scope, if any (trace-time lookup)."""
+  strat = Env.get().strategy_context.current
+  if strat is not None and strat.kind == "split":
+    return strat
+  return None
+
+
+def _constraint(x, spec: P):
+  """Apply a sharding constraint if a mesh is active (no-op otherwise)."""
+  try:
+    return jax.lax.with_sharding_constraint(x, spec)
+  except Exception:
+    return x
+
+
+def _check_divisible(dim: int, what: str):
+  env = Env.get()
+  if env.cluster is None or env.cluster._mesh is None:
+    return
+  model = env.cluster.axis_size(constants.MODEL_AXIS)
+  if model > 1 and dim % model != 0:
+    raise ValueError(
+        f"{what}={dim} is not divisible by the model-parallel axis size "
+        f"{model}; GSPMD requires even shards (the reference's "
+        f"remainder-to-shard-0 scheme is not TPU-friendly)")
+
+
+class Dense(nn.Module):
+  """Dense layer; tensor-parallel when called under a ``split`` scope.
+
+  ``parallel``: "auto" (from ambient scope → column), "column", "row", or
+  "none".  Column-parallel output stays sharded on the feature dim (use a
+  row-parallel layer next, or ``split_to_replica`` to gather), mirroring
+  the reference where consumers see the sharded dense output
+  (epl/ops/distributed_dense.py:146-193).
+  """
+
+  features: int
+  use_bias: bool = True
+  parallel: str = "auto"
+  dtype: Optional[Dtype] = None
+  param_dtype: Dtype = jnp.float32
+  kernel_init: Callable = default_kernel_init
+  bias_init: Callable = nn.initializers.zeros_init()
+
+  @nn.compact
+  def __call__(self, x):
+    mode = self.parallel
+    if mode == "auto":
+      mode = "column" if _active_split() is not None else "none"
+    if mode not in ("none", "column", "row"):
+      raise ValueError(f"Dense.parallel must be auto/none/column/row, "
+                       f"got {self.parallel!r}")
+    in_features = x.shape[-1]
+    kshape = (in_features, self.features)
+
+    if mode == "column":
+      _check_divisible(self.features, "Dense.features")
+      kernel_init = nn.with_partitioning(
+          self.kernel_init, (None, constants.MODEL_AXIS))
+      bias_spec: Tuple = (constants.MODEL_AXIS,)
+    elif mode == "row":
+      _check_divisible(in_features, "Dense input features")
+      kernel_init = nn.with_partitioning(
+          self.kernel_init, (constants.MODEL_AXIS, None))
+      bias_spec = (None,)
+    else:
+      kernel_init = self.kernel_init
+      bias_spec = (None,)
+
+    kernel = self.param("kernel", kernel_init, kshape, self.param_dtype)
+    dtype = self.dtype or x.dtype
+    y = jnp.matmul(x.astype(dtype), jnp.asarray(kernel, dtype))
+    if mode == "column":
+      y = _constraint(y, P(*([None] * (y.ndim - 1)), constants.MODEL_AXIS))
+    elif mode == "row":
+      # XLA inserts the cross-shard psum for the contracted dim; the result
+      # is replicated over the model axis.
+      y = _constraint(y, P(*([None] * y.ndim)))
+    if self.use_bias:
+      if mode == "column":
+        bias = self.param(
+            "bias", nn.with_partitioning(self.bias_init, bias_spec),
+            (self.features,), self.param_dtype)
+      else:
+        bias = self.param("bias", self.bias_init, (self.features,),
+                          self.param_dtype)
+      y = y + jnp.asarray(bias, dtype)
+    return y
+
+
+class Embedding(nn.Module):
+  """Token embedding; vocab-sharded under a ``split`` scope.
+
+  The reference has no embedding op in its split library (embeddings stay
+  replicated there); vocab sharding is the TPU-idiomatic extension that
+  makes large-vocab GPT heads tensor-parallel end-to-end.
+  """
+
+  num_embeddings: int
+  features: int
+  parallel: str = "auto"
+  param_dtype: Dtype = jnp.float32
+  embedding_init: Callable = nn.initializers.normal(stddev=0.02)
+
+  @nn.compact
+  def __call__(self, ids):
+    tp = self.parallel == "vocab" or (
+        self.parallel == "auto" and _active_split() is not None)
+    if tp:
+      _check_divisible(self.num_embeddings, "Embedding.num_embeddings")
+      init = nn.with_partitioning(
+          self.embedding_init, (constants.MODEL_AXIS, None))
+    else:
+      init = self.embedding_init
+    table = self.param("embedding", init,
+                       (self.num_embeddings, self.features),
+                       self.param_dtype)
+    return jnp.take(jnp.asarray(table), ids, axis=0)
+
+  def attend(self, x):
+    """Tied-softmax logits: x @ table.T (logits sharded on vocab if TP)."""
+    table = self.get_variable("params", "embedding")
+    if isinstance(table, nn.Partitioned):
+      table = table.value
+    logits = jnp.matmul(x, jnp.asarray(table).T.astype(x.dtype))
+    return _constraint(
+        logits, P(*([None] * (logits.ndim - 1)), constants.MODEL_AXIS))

@@ -1,0 +1,58 @@
+"""Sharded losses.
+
+TPU-native analog of the reference's
+``distributed_sparse_softmax_cross_entropy_with_logits``
+(epl/ops/distributed_losses.py:112): the reference computes a numerically
+stable softmax over vocab-sharded logits by hand — allgather of per-shard
+maxima, shift, exp, allreduce of normalizers, one-hot mask for the local
+label range, final loss allreduce (:58-152).
+
+Here the math is written once over the *global* logical array with a
+vocab-dim sharding constraint; GSPMD lowers the ``max`` and ``sum``
+reductions into exactly those collectives (pmax/psum over the ``model``
+axis).  Same dataflow, zero hand-built communication.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from easyparallellibrary_tpu import constants
+
+
+def _vocab_sharded(logits):
+  spec = P(*([None] * (logits.ndim - 1)), constants.MODEL_AXIS)
+  try:
+    return jax.lax.with_sharding_constraint(logits, spec)
+  except Exception:
+    return logits
+
+
+def distributed_sparse_softmax_cross_entropy_with_logits(
+    labels, logits, *, z_loss: float = 0.0):
+  """Cross entropy over (possibly vocab-sharded) logits.
+
+  labels: int array [...]; logits: [..., vocab].  Returns per-example loss
+  with the same leading shape as `labels`.
+
+  `z_loss` adds the auxiliary log-normalizer penalty (stabilizes large
+  sharded softmaxes; not in the reference, standard for TPU LLM training).
+  """
+  logits = _vocab_sharded(logits)
+  # Stable shift (reference: allgather per-shard max -> global max, :58-80).
+  m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+  shifted = logits - m
+  # Global normalizer (reference: allreduce of per-shard sums, :81-100).
+  sum_exp = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
+  log_z = jnp.log(sum_exp)
+  # Pick out the label logit (reference: one-hot mask over the local label
+  # range + allreduce, :101-152); take_along_axis partitions cleanly.
+  label_logit = jnp.take_along_axis(
+      shifted, labels[..., None].astype(jnp.int32), axis=-1)
+  loss = (log_z - label_logit)[..., 0]
+  if z_loss:
+    total_log_z = (log_z + m)[..., 0]
+    loss = loss + z_loss * jnp.square(total_log_z)
+  return loss

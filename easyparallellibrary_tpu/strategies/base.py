@@ -33,20 +33,38 @@ class ParallelStrategy:
     self.index: Optional[int] = None
     self.taskgraph = None
 
-  @staticmethod
-  def _call_site_identity() -> str:
-    """Identity = the user frames of the defining call stack.
+  def _call_site_identity(self) -> str:
+    """Identity = the source location of the defining `with` statement.
 
-    Mirrors the reference's stack-hash identity
-    (epl/strategies/parallel_strategy.py:48-57): frames inside this package
-    are skipped so the identity is stable for a given user call site.
+    Plays the role of the reference's call-stack-hash identity
+    (epl/strategies/parallel_strategy.py:48-57) with one deliberate
+    difference: only the innermost *user* frame is used, not the whole
+    stack.  JAX traces the model function several times from different
+    outer call paths (eval_shape for shapes, jit for init, jit for the
+    train step), so a full-stack identity would mint a fresh pipeline
+    stage per trace; the `with` line itself is stable across traces while
+    still distinguishing sibling scopes and collapsing loop re-entries.
     """
-    frames = []
+    # Framework internals are skipped; easyparallellibrary_tpu/models is
+    # deliberately NOT skipped — bundled models open scopes and those
+    # `with` lines are their identity.
+    skip_markers = ("easyparallellibrary_tpu/strategies",
+                    "easyparallellibrary_tpu/parallel",
+                    "easyparallellibrary_tpu/ir",
+                    "easyparallellibrary_tpu/ops",
+                    "easyparallellibrary_tpu/runtime",
+                    "easyparallellibrary_tpu/__init__",
+                    "easyparallellibrary_tpu/env",
+                    "site-packages", "dist-packages", "<frozen",
+                    "importlib", "/lib/python")
+    last_user = None
     for frame in traceback.extract_stack():
-      if "easyparallellibrary_tpu" in (frame.filename or ""):
+      fname = frame.filename or ""
+      if any(m in fname for m in skip_markers):
         continue
-      frames.append(f"{frame.filename}:{frame.lineno}")
-    return "|".join(frames[-8:])
+      last_user = f"{fname}:{frame.lineno}"
+    site = last_user or "unknown"
+    return f"{site}|{self.kind}|{self.name}|{self.device_count}"
 
   def __enter__(self):
     # add_context returns the canonical strategy for this call site, which
