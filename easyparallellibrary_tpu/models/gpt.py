@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from easyparallellibrary_tpu import constants
 from easyparallellibrary_tpu.ops import Dense, Embedding
+from easyparallellibrary_tpu.ops.layers import LayerNorm  # noqa: E501
 from easyparallellibrary_tpu.ops.losses import (
     distributed_sparse_softmax_cross_entropy_with_logits,
 )
@@ -55,6 +56,11 @@ class GPTConfig:
   # Sequence parallelism: constrain activations over the seq axis.
   seq_parallel: bool = False
   attn_impl: str = "xla"             # xla | pallas_flash | ring
+  # Pipeline parallelism: blocks grouped into stages over the stage axis.
+  pipeline_stages: int = 1
+  num_micro_batch: int = 1
+  pipeline_schedule: str = "PreferBackward"
+  pipeline_debug_sequential: bool = False  # ground-truth path for tests
 
 
 def _act_spec(cfg: GPTConfig, ndim: int = 3) -> P:
@@ -138,15 +144,34 @@ class Block(nn.Module):
   @nn.compact
   def __call__(self, x):
     cfg = self.cfg
-    y = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+    y = LayerNorm(dtype=cfg.dtype, name="ln1")(x)
     x = x + CausalSelfAttention(cfg, name="attn")(y)
-    y = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+    y = LayerNorm(dtype=cfg.dtype, name="ln2")(x)
     if self.use_moe:
       from easyparallellibrary_tpu.models.moe import MoEMLP
       x = x + MoEMLP(cfg, name="moe")(y)
     else:
       x = x + MLP(cfg, name="mlp")(y)
     return _constrain(x, _act_spec(cfg))
+
+
+class StageBlocks(nn.Module):
+  """One pipeline stage = a contiguous chunk of transformer blocks.
+
+  Stages must be homogeneous so they can be stacked and vmapped over the
+  stage axis; with MoE, the expert pattern repeats per stage.
+  """
+
+  cfg: GPTConfig
+  blocks_per_stage: int
+
+  @nn.compact
+  def __call__(self, x):
+    cfg = self.cfg
+    for i in range(self.blocks_per_stage):
+      use_moe = cfg.num_experts > 0 and (i % cfg.moe_every == 1)
+      x = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
+    return x
 
 
 def _remat_policy(name: str):
@@ -171,21 +196,41 @@ class GPT(nn.Module):
                     parallel="vocab" if cfg.tensor_parallel else "none",
                     param_dtype=cfg.param_dtype, name="wte")
     pos_init = nn.initializers.normal(stddev=0.02)
-    pos = self.param("wpe", pos_init, (cfg.max_seq_len, cfg.d_model),
+    pos = self.param("wpe", nn.with_partitioning(pos_init, (None, None)), (cfg.max_seq_len, cfg.d_model),
                      cfg.param_dtype)
     x = tok(ids).astype(cfg.dtype) + pos[None, :S].astype(cfg.dtype)
     x = _constrain(x, _act_spec(cfg))
 
-    block_cls = Block
-    if cfg.remat:
-      block_cls = nn.checkpoint(
-          Block, policy=_remat_policy(cfg.remat_policy),
-          prevent_cse=False)
-    for i in range(cfg.num_layers):
-      use_moe = cfg.num_experts > 0 and (i % cfg.moe_every == 1)
-      x = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x)
+    if cfg.pipeline_stages > 1:
+      from easyparallellibrary_tpu.parallel.pipeline import Pipeline
+      from easyparallellibrary_tpu.strategies.scheduler import get_scheduler
+      if cfg.num_layers % cfg.pipeline_stages != 0:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} must divide into "
+            f"pipeline_stages={cfg.pipeline_stages} homogeneous stages")
+      sched = get_scheduler(cfg.pipeline_schedule)
+      x = Pipeline(
+          stage_module_cls=StageBlocks,
+          stage_kwargs=dict(
+              cfg=cfg,
+              blocks_per_stage=cfg.num_layers // cfg.pipeline_stages),
+          num_stages=cfg.pipeline_stages,
+          num_micro_batch=cfg.num_micro_batch,
+          sequential=cfg.pipeline_debug_sequential,
+          remat_stage=sched.remat_stage or cfg.remat,
+          seq_parallel=cfg.seq_parallel,
+          name="pipeline")(x)
+    else:
+      block_cls = Block
+      if cfg.remat:
+        block_cls = nn.checkpoint(
+            Block, policy=_remat_policy(cfg.remat_policy),
+            prevent_cse=False)
+      for i in range(cfg.num_layers):
+        use_moe = cfg.num_experts > 0 and (i % cfg.moe_every == 1)
+        x = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x)
 
-    x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+    x = LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
     if cfg.tie_embeddings:
       logits = tok.attend(x)
     else:

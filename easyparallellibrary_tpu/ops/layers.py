@@ -107,7 +107,10 @@ class Dense(nn.Module):
           self.kernel_init, (constants.MODEL_AXIS, None))
       bias_spec = (None,)
     else:
-      kernel_init = self.kernel_init
+      # Box even unsharded params (all-None spec): lifted transforms like
+      # the pipeline's nn.vmap extend metadata with the stage axis, which
+      # only exists on boxed params.
+      kernel_init = nn.with_partitioning(self.kernel_init, (None, None))
       bias_spec = (None,)
 
     kernel = self.param("kernel", kernel_init, kshape, self.param_dtype)
@@ -120,15 +123,20 @@ class Dense(nn.Module):
       # is replicated over the model axis.
       y = _constraint(y, P(*([None] * y.ndim)))
     if self.use_bias:
-      if mode == "column":
-        bias = self.param(
-            "bias", nn.with_partitioning(self.bias_init, bias_spec),
-            (self.features,), self.param_dtype)
-      else:
-        bias = self.param("bias", self.bias_init, (self.features,),
-                          self.param_dtype)
+      bias = self.param(
+          "bias", nn.with_partitioning(self.bias_init, bias_spec),
+          (self.features,), self.param_dtype)
       y = y + jnp.asarray(bias, dtype)
     return y
+
+
+class LayerNorm(nn.LayerNorm):
+  """LayerNorm with boxed (metadata-carrying) scale/bias, so pipeline
+  stacking can shard them over the stage axis."""
+  scale_init: Callable = nn.with_partitioning(
+      nn.initializers.ones_init(), (None,))
+  bias_init: Callable = nn.with_partitioning(
+      nn.initializers.zeros_init(), (None,))
 
 
 class Embedding(nn.Module):
@@ -154,7 +162,7 @@ class Embedding(nn.Module):
       init = nn.with_partitioning(
           self.embedding_init, (constants.MODEL_AXIS, None))
     else:
-      init = self.embedding_init
+      init = nn.with_partitioning(self.embedding_init, (None, None))
     table = self.param("embedding", init,
                        (self.num_embeddings, self.features),
                        self.param_dtype)
@@ -163,7 +171,7 @@ class Embedding(nn.Module):
   def attend(self, x):
     """Tied-softmax logits: x @ table.T (logits sharded on vocab if TP)."""
     table = self.get_variable("params", "embedding")
-    if isinstance(table, nn.Partitioned):
+    while hasattr(table, "value"):
       table = table.value
     logits = jnp.matmul(x, jnp.asarray(table).T.astype(x.dtype))
     return _constraint(

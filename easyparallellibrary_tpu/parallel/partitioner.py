@@ -1,0 +1,106 @@
+"""Stage partitioning utilities.
+
+Analog of the reference's ``epl/parallel/partitioner.py``: weighted
+contiguous bucketing (`partition_balance` :44-69, `partition_stages`
+:124-164) and repeated-block detection (`find_repeated_blocks` :79-121),
+shared by the auto-pipeline planner and the auto gradient-checkpoint
+search.  Here the unit is a module/block (pytree subtree), not a TF op.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Dict, List, Sequence, Tuple
+
+
+def partition_balance(weights: Sequence[float], num_parts: int
+                      ) -> List[Tuple[int, int]]:
+  """Split `weights` into `num_parts` contiguous ranges minimizing the
+  max range sum.  Returns [(start, end), ...) half-open ranges.
+
+  The reference uses a greedy average-chasing pass
+  (partitioner.py:44-69); this uses binary search on capacity + greedy
+  fill, which is optimal for the contiguous min-max problem.
+  """
+  n = len(weights)
+  if num_parts <= 0:
+    raise ValueError("num_parts must be positive")
+  if num_parts > n:
+    raise ValueError(f"cannot split {n} items into {num_parts} parts")
+
+  def parts_needed(cap: float) -> int:
+    count, acc = 1, 0.0
+    for w in weights:
+      if w > cap:
+        return num_parts + 1  # infeasible capacity
+      if acc + w > cap:
+        count += 1
+        acc = w
+      else:
+        acc += w
+    return count
+
+  lo, hi = max(weights), sum(weights)
+  for _ in range(64):
+    mid = (lo + hi) / 2
+    if parts_needed(mid) <= num_parts:
+      hi = mid
+    else:
+      lo = mid
+  cap = hi
+  # Build ranges greedily at the found capacity, then pad out to exactly
+  # num_parts (trailing singletons) if greedy used fewer.
+  ranges: List[Tuple[int, int]] = []
+  start, acc = 0, 0.0
+  for i, w in enumerate(weights):
+    if acc + w > cap and i > start:
+      ranges.append((start, i))
+      start, acc = i, w
+    else:
+      acc += w
+  ranges.append((start, n))
+  while len(ranges) < num_parts:
+    # Split the heaviest splittable range.
+    idx = max((j for j in range(len(ranges))
+               if ranges[j][1] - ranges[j][0] > 1),
+              key=lambda j: sum(weights[ranges[j][0]:ranges[j][1]]),
+              default=None)
+    if idx is None:
+      break
+    s, e = ranges[idx]
+    best_k, best_cost = s + 1, float("inf")
+    for k in range(s + 1, e):
+      cost = max(sum(weights[s:k]), sum(weights[k:e]))
+      if cost < best_cost:
+        best_k, best_cost = k, cost
+    ranges[idx:idx + 1] = [(s, best_k), (best_k, e)]
+  return ranges
+
+
+def find_repeated_blocks(names: Sequence[str]) -> "OrderedDict[str, List[str]]":
+  """Group names by their repeated-layer pattern.
+
+  The reference detects repeated blocks by scope-name + op-type histogram
+  (partitioner.py:79-121); here the flax module path convention
+  (``block_0``, ``block_1``, ``h/3/attn`` ...) makes a numeric-suffix /
+  numeric-component normalization sufficient: names whose normalized form
+  (digits → ``#``) matches belong to the same repeated family.
+  """
+  groups: "OrderedDict[str, List[str]]" = OrderedDict()
+  for name in names:
+    key = re.sub(r"\d+", "#", name)
+    groups.setdefault(key, []).append(name)
+  return groups
+
+
+def partition_stages(block_names: Sequence[str],
+                     num_stages: int,
+                     weights: Dict[str, float] | None = None
+                     ) -> List[List[str]]:
+  """Partition an ordered list of blocks into `num_stages` contiguous
+  groups balanced by weight (param count / flops).  Reference:
+  partition_stages (partitioner.py:124-164)."""
+  ws = [float(weights.get(b, 1.0)) if weights else 1.0 for b in block_names]
+  ranges = partition_balance(ws, num_stages)
+  return [list(block_names[s:e]) for s, e in ranges]

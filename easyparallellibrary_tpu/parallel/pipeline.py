@@ -1,0 +1,141 @@
+"""Pipeline parallelism — spatial pipelining over the ``stage`` mesh axis.
+
+TPU-native replacement for the reference's pipeline machinery, which clones
+the graph per micro-batch (epl/parallel/graph_editor.py:397-421) and
+encodes the schedule as control-dependency edges between per-(stage,
+micro-batch) op sets (epl/strategies/scheduler.py).  Here the pipeline is a
+*single SPMD program*:
+
+  * stage parameters are stacked on a leading ``[num_stages, ...]`` dim and
+    sharded ``P("stage", ...)`` — each device group holds one stage;
+  * a rolling activation buffer ``state[num_stages, micro_batch, ...]``
+    moves data between stages with ``jnp.roll`` along the stage-sharded
+    dim, which XLA lowers to a collective-permute over ICI;
+  * one tick applies *all* stages at once via ``vmap`` over the stacked
+    dim — spatially parallel, temporally pipelined;
+  * reverse-mode autodiff through the tick loop yields the backward
+    pipeline automatically (reverse collective-permutes), with micro-batch
+    gradient accumulation falling out of the sum over ticks — the
+    aggregation the reference builds by hand
+    (epl/parallel/graph_editor.py:610-668).
+
+Schedules (reference epl/strategies/scheduler.py:120-131) map to memory
+policies rather than control edges — see strategies/scheduler.py.
+
+The bubble fraction is the textbook (S-1)/(M+S-1); MFU accounting in the
+profiler uses this.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from easyparallellibrary_tpu import constants
+
+
+def _constrain(x, spec: P):
+  try:
+    return jax.lax.with_sharding_constraint(x, spec)
+  except Exception:
+    return x
+
+
+def _state_spec(ndim: int, seq_parallel: bool = False) -> P:
+  """[stage, micro_batch, (seq), ...] activation buffer sharding."""
+  seq = constants.SEQ_AXIS if seq_parallel else None
+  tail = [None] * (ndim - 3)
+  return P(constants.STAGE_AXIS, constants.DATA_AXIS, seq, *tail)
+
+
+class Pipeline(nn.Module):
+  """Runs `stage_module` as an S-stage, M-micro-batch pipeline.
+
+  `stage_module` maps ``[mb, ...] -> [mb, ...]`` (same shape); it is
+  stacked S times with params sharded over the stage axis.  The wrapper
+  maps ``[batch, ...] -> [batch, ...]`` like the underlying sequential
+  model, so swapping pipeline on/off does not change the caller.
+
+  ``sequential=True`` applies the same stacked params one stage after
+  another without micro-batching — the ground-truth path used by the
+  numeric-equivalence tests (and by single-device debugging).
+  """
+
+  stage_module_cls: Any            # nn.Module subclass
+  stage_kwargs: dict
+  num_stages: int
+  num_micro_batch: int
+  sequential: bool = False
+  remat_stage: bool = False
+  seq_parallel: bool = False
+
+  def _stacked(self):
+    cls = self.stage_module_cls
+    if self.remat_stage:
+      cls = nn.checkpoint(cls, prevent_cse=False)
+    vmapped = nn.vmap(
+        cls,
+        in_axes=0, out_axes=0,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+        metadata_params={nn.meta.PARTITION_NAME: constants.STAGE_AXIS},
+    )
+    return vmapped(name="stages", **self.stage_kwargs)
+
+  @nn.compact
+  def __call__(self, x):
+    S = self.num_stages
+    M = self.num_micro_batch
+    stacked = self._stacked()
+
+    if self.sequential or S == 1:
+      # Apply stages one after another on the full batch.  Implemented by
+      # rotating the batch through the stacked module so the parameter
+      # structure is identical to the pipelined path: at each of S steps,
+      # all stage rows compute but only the row matching the current step
+      # contributes to the carried value.
+      y = x
+      for s in range(S):
+        stacked_in = jnp.broadcast_to(y[None], (S,) + y.shape)
+        out = stacked(stacked_in)
+        y = out[s]
+      return y
+
+    B = x.shape[0]
+    if B % M != 0:
+      raise ValueError(f"batch {B} not divisible by num_micro_batch {M}")
+    mb_shape = (B // M,) + x.shape[1:]
+    mbs = x.reshape((M,) + mb_shape)
+
+    state = jnp.zeros((S,) + mb_shape, x.dtype)
+    state = _constrain(state, _state_spec(state.ndim, self.seq_parallel))
+    outputs = jnp.zeros((M,) + mb_shape, x.dtype)
+
+    T = M + S - 1
+    for t in range(T):
+      # Shift the buffer one stage down the ring and feed the next
+      # micro-batch into stage 0 (ticks past M re-feed the last one; their
+      # results are never collected so they contribute nothing to grads).
+      shifted = jnp.roll(state, shift=1, axis=0)
+      feed = mbs[min(t, M - 1)]
+      shifted = shifted.at[0].set(feed)
+      shifted = _constrain(shifted,
+                           _state_spec(state.ndim, self.seq_parallel))
+      state = stacked(shifted)
+      state = _constrain(state,
+                         _state_spec(state.ndim, self.seq_parallel))
+      if t >= S - 1:
+        outputs = outputs.at[t - (S - 1)].set(state[S - 1])
+
+    return outputs.reshape(x.shape)
+
+
+def bubble_fraction(num_stages: int, num_micro_batch: int) -> float:
+  """GPipe bubble: (S-1)/(M+S-1) — reported by the profiler
+  (reference analog: schedule efficiency of scheduler.py policies)."""
+  return (num_stages - 1) / (num_micro_batch + num_stages - 1)

@@ -1,0 +1,54 @@
+"""Pipeline schedule policies.
+
+The reference encodes schedules as control-dependency edges between
+per-(stage, micro-batch) entrance/exit op sets
+(epl/strategies/scheduler.py:36-116): ``PreferForward`` is GPipe-like,
+``PreferBackward`` is 1F1B-like (bounds live activations), and
+``PreferBackwardOptimizer`` additionally interleaves the optimizer apply.
+
+In the SPMD pipeline (parallel/pipeline.py) the *order* of work is fixed
+by dataflow — XLA schedules it — so the policies map onto what they
+actually bought on GPUs: peak-memory behavior.
+
+  * PreferForward          — keep all micro-batch activations (fastest,
+                             GPipe memory profile).
+  * PreferBackward         — rematerialize each stage's forward during the
+                             backward pass, so live activations stay ~one
+                             micro-batch per stage (1F1B memory profile).
+  * PreferBackwardOptimizer— PreferBackward + grouped optimizer apply
+                             (see runtime/optimizer_helper.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from easyparallellibrary_tpu import constants
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+  name: str
+  remat_stage: bool
+  grouped_apply: bool
+
+
+_SCHEDULES = {
+    constants.SCHEDULE_PREFER_FORWARD: Schedule(
+        constants.SCHEDULE_PREFER_FORWARD, remat_stage=False,
+        grouped_apply=False),
+    constants.SCHEDULE_PREFER_BACKWARD: Schedule(
+        constants.SCHEDULE_PREFER_BACKWARD, remat_stage=True,
+        grouped_apply=False),
+    constants.SCHEDULE_PREFER_BACKWARD_OPT: Schedule(
+        constants.SCHEDULE_PREFER_BACKWARD_OPT, remat_stage=True,
+        grouped_apply=True),
+}
+
+
+def get_scheduler(name: str) -> Schedule:
+  """Reference: get_scheduler registry (epl/strategies/scheduler.py:126)."""
+  if name not in _SCHEDULES:
+    raise ValueError(f"Unknown pipeline schedule {name!r}; "
+                     f"one of {sorted(_SCHEDULES)}")
+  return _SCHEDULES[name]

@@ -1,0 +1,184 @@
+"""Pipeline parallelism tests (reference analog: tests/scheduler_test.py +
+the pipeline numeric-equivalence style)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.parallel.pipeline import Pipeline, bubble_fraction
+from easyparallellibrary_tpu.parallel.partitioner import (
+    find_repeated_blocks, partition_balance, partition_stages)
+from easyparallellibrary_tpu.strategies.scheduler import get_scheduler
+
+
+from easyparallellibrary_tpu import ops
+
+
+class ToyStage(nn.Module):
+  """One stage: Dense + nonlinearity (shape-preserving)."""
+  width: int = 16
+
+  @nn.compact
+  def __call__(self, x):
+    return jnp.tanh(ops.Dense(self.width, parallel="none")(x))
+
+
+def _pipelines(S=4, M=4, sequential=False):
+  return Pipeline(stage_module_cls=ToyStage,
+                  stage_kwargs=dict(width=16),
+                  num_stages=S, num_micro_batch=M,
+                  sequential=sequential)
+
+
+def test_pipeline_matches_sequential():
+  epl.init()
+  mesh = epl.init().cluster.build_mesh(stage=4)
+  x = jnp.asarray(np.random.RandomState(0).randn(16, 16), jnp.float32)
+
+  pipe = _pipelines(sequential=False)
+  seq = _pipelines(sequential=True)
+  params = pipe.init(jax.random.PRNGKey(0), x)["params"]
+
+  out_pipe = jax.jit(lambda p, v: pipe.apply({"params": p}, v))(params, x)
+  out_seq = jax.jit(lambda p, v: seq.apply({"params": p}, v))(params, x)
+  np.testing.assert_allclose(out_pipe, out_seq, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_grads_match_sequential():
+  epl.init()
+  mesh = epl.init().cluster.build_mesh(stage=4)
+  x = jnp.asarray(np.random.RandomState(1).randn(16, 16), jnp.float32)
+
+  pipe = _pipelines(sequential=False)
+  seq = _pipelines(sequential=True)
+  params = pipe.init(jax.random.PRNGKey(0), x)["params"]
+
+  def loss(apply_mod):
+    return lambda p: jnp.mean(apply_mod.apply({"params": p}, x) ** 2)
+
+  g_pipe = jax.jit(jax.grad(loss(pipe)))(params)
+  g_seq = jax.jit(jax.grad(loss(seq)))(params)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+      g_pipe, g_seq)
+
+
+def test_stage_params_sharded_on_stage_axis():
+  env = epl.init()
+  mesh = env.cluster.build_mesh(stage=4)
+  x = jnp.ones((16, 16))
+  pipe = _pipelines()
+
+  from easyparallellibrary_tpu.parallel import (
+      create_sharded_train_state, TrainState)
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=pipe.apply,
+                             params=pipe.init(rng, x)["params"],
+                             tx=optax.sgd(0.1))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+  kernel = state.params["stages"]["Dense_0"]["kernel"].value
+  assert kernel.shape[0] == 4  # stacked stage dim
+  assert kernel.sharding.shard_shape(kernel.shape)[0] == 1  # 1 stage/group
+
+
+def test_pipeline_training_decreases_loss():
+  env = epl.init()
+  mesh = env.cluster.build_mesh(stage=4)
+  x = jnp.asarray(np.random.RandomState(0).randn(16, 16), jnp.float32)
+  y = jnp.asarray(np.random.RandomState(1).randn(16, 16), jnp.float32)
+
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state, make_train_step, parallelize)
+
+  pipe = _pipelines()
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=pipe.apply,
+                             params=pipe.init(rng, x)["params"],
+                             tx=optax.adam(1e-2))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+
+  def loss_fn(params, batch, rng):
+    pred = pipe.apply({"params": params}, batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2), {}
+
+  step = parallelize(make_train_step(loss_fn), mesh, shardings)
+  losses = []
+  for _ in range(10):
+    state, m = step(state, {"x": x, "y": y}, jax.random.PRNGKey(1))
+    losses.append(float(m["loss"]))
+  assert losses[-1] < losses[0]
+
+
+def test_gpt_pipeline_matches_gpt_sequential():
+  from easyparallellibrary_tpu.models import GPT, GPTConfig
+  from easyparallellibrary_tpu.models.gpt import gpt_loss
+
+  env = epl.init()
+  mesh = env.cluster.build_mesh(stage=2)
+  base = dict(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+              d_ff=64, max_seq_len=16, dtype=jnp.float32,
+              pipeline_stages=2, num_micro_batch=4)
+  pp = GPT(GPTConfig(**base))
+  seq = GPT(GPTConfig(**base, pipeline_debug_sequential=True))
+
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 17)),
+                    jnp.int32)
+  params = pp.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+
+  l_pp, _ = jax.jit(lambda p: gpt_loss(pp, p, {"ids": ids}))(params)
+  l_seq, _ = jax.jit(lambda p: gpt_loss(seq, p, {"ids": ids}))(params)
+  np.testing.assert_allclose(float(l_pp), float(l_seq), rtol=1e-5)
+
+  g_pp = jax.jit(jax.grad(lambda p: gpt_loss(pp, p, {"ids": ids})[0]))(params)
+  g_seq = jax.jit(jax.grad(lambda p: gpt_loss(seq, p, {"ids": ids})[0]))(
+      params)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-5),
+      g_pp, g_seq)
+
+
+def test_pipeline_batch_not_divisible_raises():
+  epl.init().cluster.build_mesh(stage=4)
+  pipe = _pipelines(S=4, M=3)
+  with pytest.raises(ValueError):
+    pipe.init(jax.random.PRNGKey(0), jnp.ones((16, 16)))
+
+
+def test_bubble_fraction():
+  assert bubble_fraction(4, 4) == pytest.approx(3 / 7)
+  assert bubble_fraction(1, 8) == 0.0
+
+
+def test_scheduler_registry():
+  assert get_scheduler("PreferForward").remat_stage is False
+  assert get_scheduler("PreferBackward").remat_stage is True
+  assert get_scheduler("PreferBackwardOptimizer").grouped_apply is True
+  with pytest.raises(ValueError):
+    get_scheduler("bogus")
+
+
+def test_partition_balance():
+  ranges = partition_balance([1, 1, 1, 1, 8, 1, 1, 1], 2)
+  assert len(ranges) == 2
+  # The heavy item should not share a part with everything else.
+  sums = [sum([1, 1, 1, 1, 8, 1, 1, 1][s:e]) for s, e in ranges]
+  assert max(sums) <= 12 - min(sums) or max(sums) == 8 + 3
+
+
+def test_partition_stages_and_repeated_blocks():
+  names = [f"block_{i}" for i in range(8)] + ["ln_f"]
+  groups = find_repeated_blocks(names)
+  assert groups["block_#"] == [f"block_{i}" for i in range(8)]
+  stages = partition_stages([f"block_{i}" for i in range(8)], 4)
+  assert [len(s) for s in stages] == [2, 2, 2, 2]
+  assert stages[0] == ["block_0", "block_1"]
