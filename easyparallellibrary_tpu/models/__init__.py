@@ -1,3 +1,12 @@
 from easyparallellibrary_tpu.models.gpt import GPT, GPTConfig
+from easyparallellibrary_tpu.models.bert import (
+    Bert, BertConfig, bert_large_config,
+)
+from easyparallellibrary_tpu.models.resnet import (
+    ResNet, ResNetConfig, resnet18_config, resnet50_config,
+)
 
-__all__ = ["GPT", "GPTConfig"]
+__all__ = [
+    "GPT", "GPTConfig", "Bert", "BertConfig", "bert_large_config",
+    "ResNet", "ResNetConfig", "resnet18_config", "resnet50_config",
+]

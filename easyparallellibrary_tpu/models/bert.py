@@ -1,0 +1,162 @@
+"""BERT — bidirectional encoder family (BASELINE config 2: BERT-Large
+2-stage pipeline with 4 micro-batches, the reference's pipeline tutorial
+model, /root/reference/docs/en/tutorials/pipe.md:33-48).
+
+Shares the TPU-first machinery with GPT: tensor-parallel ops layers,
+stage-stacked pipeline over the ``stage`` axis, bf16 compute.  Trains with
+a masked-LM objective through the tied embedding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from easyparallellibrary_tpu import constants
+from easyparallellibrary_tpu.ops import Dense, Embedding
+from easyparallellibrary_tpu.ops.layers import LayerNorm
+from easyparallellibrary_tpu.ops.losses import (
+    distributed_sparse_softmax_cross_entropy_with_logits,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+  vocab_size: int = 30528          # multiple of 64 for sharding
+  num_layers: int = 12
+  num_heads: int = 12
+  d_model: int = 768
+  d_ff: int = 3072
+  max_seq_len: int = 512
+  type_vocab_size: int = 2
+  dtype: Any = jnp.bfloat16
+  param_dtype: Any = jnp.float32
+  tensor_parallel: bool = False
+  remat: bool = False
+  pipeline_stages: int = 1
+  num_micro_batch: int = 1
+  pipeline_schedule: str = "PreferBackward"
+  pipeline_debug_sequential: bool = False
+
+
+def bert_large_config(**kw):
+  base = dict(num_layers=24, num_heads=16, d_model=1024, d_ff=4096)
+  base.update(kw)
+  return BertConfig(**base)
+
+
+def _constrain(x, spec: P):
+  try:
+    return jax.lax.with_sharding_constraint(x, spec)
+  except Exception:
+    return x
+
+
+class EncoderBlock(nn.Module):
+  cfg: BertConfig
+
+  @nn.compact
+  def __call__(self, x):
+    cfg = self.cfg
+    B, S, D = x.shape
+    H = cfg.num_heads
+    col = "column" if cfg.tensor_parallel else "none"
+    row = "row" if cfg.tensor_parallel else "none"
+
+    y = LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+    qkv = Dense(3 * D, parallel=col, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="qkv")(y)
+    qkv = qkv.reshape(B, S, 3, H, D // H)
+    qkv = _constrain(qkv, P(constants.DATA_AXIS, None, None,
+                            constants.MODEL_AXIS, None))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    scale = 1.0 / jnp.sqrt(D // H).astype(cfg.dtype)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(cfg.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, D)
+    x = x + Dense(D, parallel=row, dtype=cfg.dtype,
+                  param_dtype=cfg.param_dtype, name="proj")(attn)
+
+    y = LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+    h = nn.gelu(Dense(cfg.d_ff, parallel=col, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype, name="wi")(y))
+    x = x + Dense(D, parallel=row, dtype=cfg.dtype,
+                  param_dtype=cfg.param_dtype, name="wo")(h)
+    return _constrain(x, P(constants.DATA_AXIS, None, None))
+
+
+class BertStage(nn.Module):
+  cfg: BertConfig
+  blocks_per_stage: int
+
+  @nn.compact
+  def __call__(self, x):
+    for i in range(self.blocks_per_stage):
+      x = EncoderBlock(self.cfg, name=f"block_{i}")(x)
+    return x
+
+
+class Bert(nn.Module):
+  cfg: BertConfig
+
+  @nn.compact
+  def __call__(self, ids, type_ids=None):
+    cfg = self.cfg
+    B, S = ids.shape
+    tok = Embedding(cfg.vocab_size, cfg.d_model,
+                    parallel="vocab" if cfg.tensor_parallel else "none",
+                    param_dtype=cfg.param_dtype, name="wte")
+    pos = self.param(
+        "wpe", nn.with_partitioning(nn.initializers.normal(0.02),
+                                    (None, None)),
+        (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+    seg = Embedding(cfg.type_vocab_size, cfg.d_model, parallel="none",
+                    param_dtype=cfg.param_dtype, name="wse")
+    if type_ids is None:
+      type_ids = jnp.zeros_like(ids)
+    x = (tok(ids).astype(cfg.dtype) + pos[None, :S].astype(cfg.dtype)
+         + seg(type_ids).astype(cfg.dtype))
+    x = LayerNorm(dtype=cfg.dtype, name="ln_emb")(x)
+    x = _constrain(x, P(constants.DATA_AXIS, None, None))
+
+    if cfg.pipeline_stages > 1:
+      from easyparallellibrary_tpu.parallel.pipeline import Pipeline
+      from easyparallellibrary_tpu.strategies.scheduler import get_scheduler
+      if cfg.num_layers % cfg.pipeline_stages != 0:
+        raise ValueError("num_layers must divide pipeline_stages")
+      sched = get_scheduler(cfg.pipeline_schedule)
+      x = Pipeline(
+          stage_module_cls=BertStage,
+          stage_kwargs=dict(
+              cfg=cfg,
+              blocks_per_stage=cfg.num_layers // cfg.pipeline_stages),
+          num_stages=cfg.pipeline_stages,
+          num_micro_batch=cfg.num_micro_batch,
+          sequential=cfg.pipeline_debug_sequential,
+          remat_stage=sched.remat_stage or cfg.remat,
+          name="pipeline")(x)
+    else:
+      block_cls = EncoderBlock
+      if cfg.remat:
+        block_cls = nn.checkpoint(EncoderBlock, prevent_cse=False)
+      for i in range(cfg.num_layers):
+        x = block_cls(cfg, name=f"block_{i}")(x)
+
+    x = LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+    return tok.attend(x)   # MLM logits via tied embedding
+
+
+def bert_mlm_loss(model: Bert, params, batch, rng=None):
+  """Masked-LM loss; batch = {"ids": [B,S], "labels": [B,S],
+  "mask": [B,S] float (1 where a token is masked/predicted)}."""
+  logits = model.apply({"params": params}, batch["ids"])
+  loss = distributed_sparse_softmax_cross_entropy_with_logits(
+      batch["labels"], logits.astype(jnp.float32))
+  mask = batch["mask"].astype(jnp.float32)
+  total = jnp.sum(loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+  return total, {}

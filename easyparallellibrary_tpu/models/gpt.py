@@ -53,6 +53,8 @@ class GPTConfig:
   num_experts: int = 0
   moe_every: int = 2
   capacity_factor: float = 1.25
+  moe_aux_weight: float = 0.01
+  moe_top_k: int = 1
   # Sequence parallelism: constrain activations over the seq axis.
   seq_parallel: bool = False
   attn_impl: str = "xla"             # xla | pallas_flash | ring
@@ -149,7 +151,7 @@ class Block(nn.Module):
     y = LayerNorm(dtype=cfg.dtype, name="ln2")(x)
     if self.use_moe:
       from easyparallellibrary_tpu.models.moe import MoEMLP
-      x = x + MoEMLP(cfg, name="moe")(y)
+      x = x + MoEMLP(cfg, top_k=cfg.moe_top_k, name="moe")(y)
     else:
       x = x + MLP(cfg, name="mlp")(y)
     return _constrain(x, _act_spec(cfg))
@@ -242,13 +244,28 @@ class GPT(nn.Module):
 
 
 def gpt_loss(model: GPT, params, batch, rng=None):
-  """Next-token cross entropy; batch = {"ids": [B, S+1] int32}."""
+  """Next-token cross entropy; batch = {"ids": [B, S+1] int32}.
+
+  With MoE enabled, the sown load-balancing losses are collected from the
+  ``losses`` collection and added with weight ``moe_aux_weight``.
+  """
   ids = batch["ids"]
   inputs, targets = ids[:, :-1], ids[:, 1:]
-  logits = model.apply({"params": params}, inputs)
+  if model.cfg.num_experts > 0:
+    logits, state = model.apply({"params": params}, inputs,
+                                mutable=["losses"])
+    aux_leaves = jax.tree_util.tree_leaves(state.get("losses", {}))
+    aux = sum(jnp.sum(l) for l in aux_leaves) if aux_leaves else 0.0
+  else:
+    logits = model.apply({"params": params}, inputs)
+    aux = 0.0
   loss = distributed_sparse_softmax_cross_entropy_with_logits(
       targets, logits.astype(jnp.float32), z_loss=model.cfg.z_loss)
-  return jnp.mean(loss), {}
+  total = jnp.mean(loss) + model.cfg.moe_aux_weight * aux
+  metrics = {}
+  if model.cfg.num_experts > 0:
+    metrics["moe_aux_loss"] = aux
+  return total, metrics
 
 
 def gpt_flops_per_token(cfg: GPTConfig, seq_len: Optional[int] = None) -> float:
