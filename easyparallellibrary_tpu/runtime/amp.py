@@ -1,0 +1,106 @@
+"""Mixed precision — dtype policy + loss scaling.
+
+TPU-native redesign of the reference's AMP O1
+(epl/runtime/amp/auto_mixed_precision.py): the reference rewrites the TF
+graph with allow/deny/gray/clear op lists and 4 propagation passes
+(:282-415) because TF1 has no dtype policy.  In JAX the policy is simply
+the dtypes the model computes in (`GPTConfig.dtype = bfloat16`, fp32
+params) — XLA keeps MXU matmuls in bf16 natively, so the graph rewrite
+has no role.
+
+Loss scaling (reference epl/runtime/amp/loss_scale.py): bf16 has fp32's
+exponent range so TPU training needs no scale; the dynamic scale is kept
+for fp16 parity and for numerically fragile models — scale the loss,
+unscale grads, skip the update on non-finite grads, grow/backoff the
+scale (the reference's conditional apply + update, loss_scale.py:44-51).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+  """Dtype policy (the role of the reference's O1 conversion lists)."""
+  param_dtype: Any = jnp.float32
+  compute_dtype: Any = jnp.bfloat16
+  output_dtype: Any = jnp.float32
+
+  def cast_to_compute(self, tree):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(self.compute_dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+class DynamicLossScale(struct.PyTreeNode):
+  """State for dynamic loss scaling (reference loss_scale_tf.py fork of
+  TF r1.15 LossScale)."""
+  scale: jnp.ndarray
+  growth_interval: int = struct.field(pytree_node=False, default=2000)
+  growth_factor: float = struct.field(pytree_node=False, default=2.0)
+  backoff_factor: float = struct.field(pytree_node=False, default=0.5)
+  counter: jnp.ndarray = struct.field(
+      default_factory=lambda: jnp.zeros((), jnp.int32))
+
+  @classmethod
+  def create(cls, initial_scale: float = 2.0 ** 15, **kw):
+    return cls(scale=jnp.float32(initial_scale), **kw)
+
+  def update(self, grads_finite) -> "DynamicLossScale":
+    grow = (self.counter + 1) >= self.growth_interval
+    new_scale = jnp.where(
+        grads_finite,
+        jnp.where(grow, self.scale * self.growth_factor, self.scale),
+        self.scale * self.backoff_factor)
+    new_scale = jnp.clip(new_scale, 1.0, 2.0 ** 24)
+    new_counter = jnp.where(grads_finite & ~grow, self.counter + 1,
+                            jnp.zeros((), jnp.int32))
+    return self.replace(scale=new_scale, counter=new_counter)
+
+
+def fixed_loss_scale(value: float) -> DynamicLossScale:
+  """A scale that never changes (reference fixed loss scale)."""
+  return DynamicLossScale(scale=jnp.float32(value),
+                          growth_factor=1.0, backoff_factor=1.0,
+                          growth_interval=2 ** 30)
+
+
+def all_finite(tree) -> jnp.ndarray:
+  leaves = [jnp.all(jnp.isfinite(l)) for l in jax.tree_util.tree_leaves(tree)
+            if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
+  if not leaves:
+    return jnp.bool_(True)
+  return jnp.stack(leaves).all()
+
+
+def scaled_value_and_grad(loss_fn: Callable, scale: jnp.ndarray,
+                          has_aux: bool = True):
+  """value_and_grad with loss scaling: scale before grad, unscale after
+  (reference: hooks.py:137-172 scale_loss/unscale_grads)."""
+
+  def scaled_loss(*args, **kw):
+    out = loss_fn(*args, **kw)
+    if has_aux:
+      loss, aux = out
+      return loss * scale.astype(loss.dtype), aux
+    return out * scale.astype(out.dtype)
+
+  def wrapped(*args, **kw):
+    if has_aux:
+      (loss, aux), grads = jax.value_and_grad(
+          scaled_loss, has_aux=True)(*args, **kw)
+    else:
+      loss, grads = jax.value_and_grad(scaled_loss)(*args, **kw)
+      aux = {}
+    inv = (1.0 / scale)
+    grads = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+    return (loss / scale.astype(loss.dtype), aux), grads
+
+  return wrapped

