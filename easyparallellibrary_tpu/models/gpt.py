@@ -100,10 +100,13 @@ class CausalSelfAttention(nn.Module):
                             constants.MODEL_AXIS, None))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
-    if cfg.attn_impl == "ring" and cfg.seq_parallel:
+    if cfg.attn_impl == "ring":
       from easyparallellibrary_tpu.sequence.ring_attention import (
           ring_attention)
       out = ring_attention(q, k, v, causal=True)
+    elif cfg.attn_impl == "ulysses":
+      from easyparallellibrary_tpu.sequence.ulysses import ulysses_attention
+      out = ulysses_attention(q, k, v, causal=True)
     elif cfg.attn_impl == "pallas_flash":
       from easyparallellibrary_tpu.kernels.flash_attention import (
           flash_attention)

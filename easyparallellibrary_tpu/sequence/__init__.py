@@ -1,0 +1,4 @@
+from easyparallellibrary_tpu.sequence.ring_attention import ring_attention
+from easyparallellibrary_tpu.sequence.ulysses import ulysses_attention
+
+__all__ = ["ring_attention", "ulysses_attention"]

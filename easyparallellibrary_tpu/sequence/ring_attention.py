@@ -1,0 +1,127 @@
+"""Ring attention — context parallelism over the ``seq`` mesh axis.
+
+Green-field subsystem: the reference has NO sequence/context parallelism
+(SURVEY §2.8/§5.7 — it scales parameters, not sequence length; its
+nearest building block is the grouped send/recv AllToAll family,
+csrc/communicators/tensorflow_nccl.h:186-301).
+
+Design (blockwise attention with online softmax, Liu et al. ring
+attention): the sequence dim is split into one block per ``seq``-axis
+device.  Each ring step, every query block attends to the KV block it
+currently holds, accumulating (max, denominator, numerator) in fp32;
+then the KV blocks rotate one position around the ring.  Expressed in
+global-array form: the rotate is ``jnp.roll`` along the seq-sharded
+block dim, which XLA lowers to a collective-permute over the ICI ring —
+compute on the current block overlaps the transfer of the next.
+
+Causality is enforced block-wise: a query block fully attends to earlier
+blocks, triangularly to its own, not at all to later ones — fully-masked
+ring steps still rotate but contribute zeros (their compute is dead
+weight only when n is large; XLA removes the masked matmul for the
+skipped pairs when it can).
+
+Each ring step is wrapped in `jax.checkpoint` so the backward pass
+rematerializes per-step scores: peak memory stays O(block²) instead of
+O(seq²) — the entire point of ring attention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from easyparallellibrary_tpu import constants
+from easyparallellibrary_tpu.env import Env
+
+NEG_INF = -1e30
+
+
+def _constrain(x, spec: P):
+  try:
+    return jax.lax.with_sharding_constraint(x, spec)
+  except Exception:
+    return x
+
+
+def _seq_axis_size() -> int:
+  env = Env.get()
+  if env.cluster is None or env.cluster._mesh is None:
+    return 1
+  return env.cluster.axis_size(constants.SEQ_AXIS)
+
+
+def _block_spec() -> P:
+  # [B, nb, s, H, D] with the block dim on the seq axis.
+  return P(constants.DATA_AXIS, constants.SEQ_AXIS, None, None, None)
+
+
+@functools.partial(jax.checkpoint, static_argnums=(5, 6),
+                   prevent_cse=False)
+def _ring_step(qb, kb, vb, acc, r, n, causal):
+  """One ring step: blockwise attention + online-softmax accumulate.
+
+  qb: [B, nb, s, H, D]; kb/vb hold block (i - r) mod n at row i.
+  acc = (o, m, l): numerator [.., s, H, D], running max / denom [.., s, H].
+  """
+  o, m, l = acc
+  scale = 1.0 / jnp.sqrt(qb.shape[-1]).astype(jnp.float32)
+  scores = jnp.einsum("bnqhd,bnkhd->bnhqk", qb, kb).astype(jnp.float32)
+  scores = scores * scale
+
+  if causal:
+    nb = qb.shape[1]
+    s = qb.shape[2]
+    block_idx = jnp.arange(nb)                   # query block i
+    k_block = (block_idx - r) % n                # source block of current kv
+    # Block-level relation: k_block > i → fully masked; == → triangular.
+    fully_masked = (k_block > block_idx)[None, :, None, None, None]
+    diagonal = (k_block == block_idx)[None, :, None, None, None]
+    tri = jnp.tril(jnp.ones((s, s), jnp.bool_))[None, None, None]
+    mask = jnp.where(diagonal, tri, True) & ~fully_masked
+    scores = jnp.where(mask, scores, NEG_INF)
+
+  step_max = jnp.max(scores, axis=-1)                         # [b,n,h,q]
+  new_m = jnp.maximum(m, step_max.transpose(0, 1, 3, 2))      # [b,n,q,h]
+  correction = jnp.exp(m - new_m)
+  probs = jnp.exp(scores - new_m.transpose(0, 1, 3, 2)[..., None])
+  step_l = jnp.sum(probs, axis=-1).transpose(0, 1, 3, 2)      # [b,n,q,h]
+  new_l = l * correction + step_l
+  step_o = jnp.einsum("bnhqk,bnkhd->bnqhd", probs.astype(qb.dtype), vb)
+  new_o = o * correction[..., None].astype(o.dtype) + step_o.astype(o.dtype)
+  return new_o, new_m, new_l
+
+
+def ring_attention(q, k, v, causal: bool = True,
+                   num_blocks: Optional[int] = None):
+  """Blockwise ring attention; q, k, v: [B, S, H, D] (seq-sharded under
+  GSPMD).  Returns [B, S, H, D].  Falls back to one block (= standard
+  blockwise attention) when no seq axis is active."""
+  B, S, H, D = q.shape
+  n = num_blocks or max(_seq_axis_size(), 1)
+  if S % n != 0:
+    raise ValueError(f"sequence length {S} not divisible by "
+                     f"{n} ring blocks")
+  s = S // n
+
+  def block(x):
+    return _constrain(x.reshape(B, n, s, H, D), _block_spec())
+
+  qb, kb, vb = block(q), block(k), block(v)
+  o = jnp.zeros((B, n, s, H, D), jnp.float32)
+  m = jnp.full((B, n, s, H), NEG_INF, jnp.float32)
+  l = jnp.zeros((B, n, s, H), jnp.float32)
+
+  for r in range(n):
+    o, m, l = _ring_step(qb, kb, vb, (o, m, l), r, n, causal)
+    if r != n - 1:
+      # Rotate KV blocks around the ring (collective-permute on the
+      # seq-sharded dim).
+      kb = _constrain(jnp.roll(kb, shift=1, axis=1), _block_spec())
+      vb = _constrain(jnp.roll(vb, shift=1, axis=1), _block_spec())
+
+  out = (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+  return _constrain(out, _block_spec()).reshape(B, S, H, D)

@@ -1,0 +1,72 @@
+"""Ulysses sequence parallelism — head↔sequence all-to-all.
+
+Green-field subsystem (absent in the reference; SURVEY §5.7 notes its
+AllToAll(v) kernels, csrc/communicators/tensorflow_nccl.h:186-265, are
+the substrate Ulysses would have used).
+
+DeepSpeed-Ulysses scheme: activations are sequence-sharded; before
+attention, an all-to-all re-shards heads across the seq axis so every
+device sees the FULL sequence for its subset of heads; attention runs
+locally; a second all-to-all restores sequence sharding.  In GSPMD this
+is two sharding constraints — seq-dim sharded → head-dim sharded →
+seq-dim sharded — and XLA materializes exactly the two all-to-alls.
+
+Requires num_heads % seq_axis_size == 0.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from easyparallellibrary_tpu import constants
+from easyparallellibrary_tpu.env import Env
+
+
+def _constrain(x, spec: P):
+  try:
+    return jax.lax.with_sharding_constraint(x, spec)
+  except Exception:
+    return x
+
+
+def _seq_axis_size() -> int:
+  env = Env.get()
+  if env.cluster is None or env.cluster._mesh is None:
+    return 1
+  return env.cluster.axis_size(constants.SEQ_AXIS)
+
+
+SEQ_SHARDED = P(constants.DATA_AXIS, constants.SEQ_AXIS, None, None)
+HEAD_SHARDED = P(constants.DATA_AXIS, None, constants.SEQ_AXIS, None)
+
+
+def ulysses_attention(q, k, v, causal: bool = True):
+  """q, k, v: [B, S, H, D] seq-sharded → attention → [B, S, H, D].
+
+  The head-sharded region computes standard full-sequence attention, so
+  any attention kernel (XLA einsum here, a Pallas flash kernel in
+  kernels/) drops in unchanged.
+  """
+  B, S, H, D = q.shape
+  n = _seq_axis_size()
+  if n > 1 and H % n != 0:
+    raise ValueError(f"Ulysses requires num_heads ({H}) divisible by the "
+                     f"seq axis size ({n})")
+
+  # all-to-all #1: seq-sharded -> head-sharded (full sequence locally).
+  q = _constrain(q, HEAD_SHARDED)
+  k = _constrain(k, HEAD_SHARDED)
+  v = _constrain(v, HEAD_SHARDED)
+
+  scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+  scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+  if causal:
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+  probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+  out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+  # all-to-all #2: back to sequence sharding.
+  return _constrain(out, SEQ_SHARDED)
