@@ -1,0 +1,17 @@
+# Build / test entry points (reference analog: /root/reference/Makefile).
+
+all: build
+
+build:
+	$(MAKE) -C csrc
+
+test: build
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+clean:
+	$(MAKE) -C csrc clean
+
+.PHONY: all build test bench clean

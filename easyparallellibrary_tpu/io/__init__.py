@@ -1,0 +1,9 @@
+from easyparallellibrary_tpu.io.sharding import shard_files, shard_batch_dim
+from easyparallellibrary_tpu.io.dataloader import (
+    RecordReader, write_records, native_io_available,
+)
+
+__all__ = [
+    "shard_files", "shard_batch_dim", "RecordReader", "write_records",
+    "native_io_available",
+]

@@ -1,0 +1,151 @@
+"""Record dataset reader — ctypes binding over the native IO runtime.
+
+The C++ library (csrc/epl_tpu_io.cc) provides threaded, prefetching,
+shard-sliced reads of length-prefixed record files; this module binds it
+via ctypes (no pybind11 in the image) with a pure-Python fallback so the
+framework works before `make build`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from typing import Iterator, List, Optional, Sequence
+
+from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.utils.logging import get_logger
+
+_LIB = None
+_LIB_TRIED = False
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "lib", "libepl_tpu_io.so")
+
+
+def _load_lib():
+  global _LIB, _LIB_TRIED
+  if _LIB_TRIED:
+    return _LIB
+  _LIB_TRIED = True
+  try:
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.epl_reader_create.restype = ctypes.c_void_p
+    lib.epl_reader_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.epl_reader_next.restype = ctypes.c_int64
+    lib.epl_reader_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int64]
+    lib.epl_reader_pending_size.restype = ctypes.c_int64
+    lib.epl_reader_pending_size.argtypes = [ctypes.c_void_p]
+    lib.epl_reader_destroy.argtypes = [ctypes.c_void_p]
+    lib.epl_writer_create.restype = ctypes.c_void_p
+    lib.epl_writer_create.argtypes = [ctypes.c_char_p]
+    lib.epl_writer_write.restype = ctypes.c_int
+    lib.epl_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64]
+    lib.epl_writer_close.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+  except OSError:
+    get_logger().info("native IO library not built (run `make build`); "
+                      "using the python reader")
+    _LIB = None
+  return _LIB
+
+
+def native_io_available() -> bool:
+  return _load_lib() is not None
+
+
+def write_records(path: str, records: Sequence[bytes],
+                  use_native: Optional[bool] = None):
+  """Write a length-prefixed record file (uint64 LE + payload)."""
+  lib = _load_lib() if use_native in (None, True) else None
+  if lib is not None and use_native is not False:
+    w = lib.epl_writer_create(path.encode())
+    if not w:
+      raise IOError(f"cannot open {path} for writing")
+    try:
+      for rec in records:
+        if lib.epl_writer_write(w, rec, len(rec)) != 0:
+          raise IOError(f"short write to {path}")
+    finally:
+      lib.epl_writer_close(w)
+    return
+  with open(path, "wb") as f:
+    for rec in records:
+      f.write(struct.pack("<Q", len(rec)))
+      f.write(rec)
+
+
+def _python_reader(files: List[str]) -> Iterator[bytes]:
+  for fname in files:
+    with open(fname, "rb") as f:
+      while True:
+        header = f.read(8)
+        if not header:
+          break
+        if len(header) != 8:
+          raise IOError(f"truncated record header in {fname}")
+        (length,) = struct.unpack("<Q", header)
+        payload = f.read(length)
+        if len(payload) != length:
+          raise IOError(f"truncated record in {fname}")
+        yield payload
+
+
+class RecordReader:
+  """Iterate records from `files`, restricted to this worker's shard.
+
+  With the native library: a C++ thread pool prefetches ahead of the
+  training loop.  Without it: a synchronous python generator with the
+  same record order and sharding.
+  """
+
+  def __init__(self, files: Sequence[str], shard_index: int = 0,
+               num_shards: int = 1, num_threads: Optional[int] = None,
+               prefetch_records: int = 256,
+               use_native: Optional[bool] = None):
+    cfg = Env.get().config
+    self.files = list(files)
+    self.shard_index = shard_index
+    self.num_shards = max(1, num_shards)
+    self.num_threads = num_threads or cfg.io.num_threads
+    self.prefetch_records = prefetch_records
+    lib = _load_lib()
+    self._native = lib is not None if use_native is None else (
+        bool(use_native) and lib is not None)
+    self._lib = lib
+    self._handle = None
+
+  def _shard(self) -> List[str]:
+    # Same round-robin assignment as the native side.
+    return [f for i, f in enumerate(self.files)
+            if i % self.num_shards == self.shard_index]
+
+  def __iter__(self) -> Iterator[bytes]:
+    if not self._native:
+      yield from _python_reader(self._shard())
+      return
+    lib = self._lib
+    c_files = (ctypes.c_char_p * len(self.files))(
+        *[f.encode() for f in self.files])
+    handle = lib.epl_reader_create(
+        c_files, len(self.files), self.shard_index, self.num_shards,
+        self.num_threads, self.prefetch_records)
+    cap = 1 << 16
+    buf = ctypes.create_string_buffer(cap)
+    try:
+      while True:
+        n = lib.epl_reader_next(handle, buf, cap)
+        if n == -1:
+          break
+        if n == -2:
+          pending = lib.epl_reader_pending_size(handle)
+          cap = max(pending, cap * 2)
+          buf = ctypes.create_string_buffer(cap)
+          continue
+        yield buf.raw[:n]
+    finally:
+      lib.epl_reader_destroy(handle)

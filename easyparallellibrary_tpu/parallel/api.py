@@ -115,8 +115,19 @@ def make_train_step(loss_fn: Callable,
   cfg = Env.get().config
   reduce_method = reduce_method or cfg.communication.gradients_reduce_method
 
+  def loss_with_collections(params, batch, rng):
+    # Collections must be drained inside the grad trace — their values are
+    # tracers of this trace (reference merges them at session-run fetch
+    # time instead, epl/parallel/parallel.py:233-353).
+    from easyparallellibrary_tpu.parallel.metrics import collect_merged
+    loss, aux = loss_fn(params, batch, rng)
+    merged = collect_merged()
+    if merged:
+      aux = {**(aux or {}), **merged}
+    return loss, aux
+
   def train_step(state, batch, rng):
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    grad_fn = jax.value_and_grad(loss_with_collections, has_aux=True)
     (loss, aux), grads = grad_fn(state.params, batch, rng)
     if reduce_method == "sum":
       # loss_fn produces a mean loss, so grads come out replica-mean;

@@ -1,0 +1,73 @@
+"""Cross-replica metric merging.
+
+Analog of the reference's merge collections
+(epl/ir/graph.py:40-64 GraphKeys + epl/parallel/parallel.py:233-353
+merge_outputs): users register tensors under GLOBAL_MEAN/SUM/CONCAT keys
+and the framework merges them across replicas with
+allreduce/allgather.
+
+Under GSPMD the semantics simplify: a value computed inside the sharded
+`jit` from the global batch *is* the global value, so
+
+  * GLOBAL_MEAN_OBJECTS  → `jnp.mean` over the value,
+  * GLOBAL_SUM_OBJECTS   → `jnp.sum`,
+  * GLOBAL_CONCAT_OBJECTS→ the value itself (its batch dim already spans
+    all replicas — the concat the reference materializes with allgather),
+  * LOCAL_* keys behave like their GLOBAL twins (there is no meaningful
+    "local replica" view of a GSPMD value) — kept for API parity.
+
+Inside explicit `shard_map` regions, `merge_shard_metrics` performs the
+collective version (psum/pmean/all_gather).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from easyparallellibrary_tpu import constants
+from easyparallellibrary_tpu.communicators import collectives
+from easyparallellibrary_tpu.constants import GraphKeys
+from easyparallellibrary_tpu.env import Env
+
+
+def _merge_one(key: str, value):
+  if key in (GraphKeys.GLOBAL_MEAN_OBJECTS, GraphKeys.LOCAL_MEAN_OBJECTS):
+    return jnp.mean(value)
+  if key in (GraphKeys.GLOBAL_SUM_OBJECTS, GraphKeys.LOCAL_SUM_OBJECTS):
+    return jnp.sum(value)
+  return value  # concat keys: already the global concatenation
+
+
+def collect_merged(clear: bool = True) -> Dict[str, Any]:
+  """Merge every registered collection value into a metrics dict.
+
+  Call inside the traced step function, after the model ran (so the
+  collections hold this trace's values).  Keys are `<collection>_<i>`.
+  """
+  env = Env.get()
+  out: Dict[str, Any] = {}
+  for key in GraphKeys.ALL_MERGE_KEYS:
+    values = env.collections.get(key, [])
+    for i, v in enumerate(values):
+      out[f"{key}_{i}"] = _merge_one(key, v)
+    if clear and key in env.collections:
+      env.collections[key] = []
+  return out
+
+
+def merge_shard_metrics(metrics: Dict[str, Any], how: str = "mean",
+                        axis_name: str = constants.DATA_AXIS
+                        ) -> Dict[str, Any]:
+  """Collective metric merge for `shard_map` regions."""
+  if how == "mean":
+    f = lambda v: collectives.all_reduce(v, axis_name, op=collectives.MEAN)
+  elif how == "sum":
+    f = lambda v: collectives.all_reduce(v, axis_name, op=collectives.SUM)
+  elif how == "concat":
+    f = lambda v: collectives.all_gather(v, axis_name, axis=0)
+  else:
+    raise ValueError(f"unknown merge method {how!r}")
+  return jax.tree_util.tree_map(f, metrics)

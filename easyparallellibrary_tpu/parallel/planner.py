@@ -1,0 +1,72 @@
+"""Auto-parallel planner — automatic pipeline-stage search.
+
+Analog of the reference's ``AutoStageGenerator``
+(epl/parallel/planner.py:37-112), which searches stage boundaries with
+three policies: balance-op-num, repeated-layers, and a heuristic mix.
+Here the unit is a block (module) list with optional weights:
+
+  * ``balance_param`` — contiguous min-max partition by parameter count
+    (the balance-op-num analog; uses partitioner.partition_balance),
+  * ``balance_flops`` — same, weighted by per-block FLOPs from the XLA
+    cost model when provided,
+  * ``repeated_layers`` — split at repeated-block family boundaries
+    (partitioner.find_repeated_blocks), then balance within the dominant
+    family.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.parallel.partitioner import (
+    find_repeated_blocks, partition_balance, partition_stages)
+from easyparallellibrary_tpu.utils.logging import get_logger
+from easyparallellibrary_tpu.utils.pytree import tree_param_count
+
+
+class AutoStageGenerator:
+  """Search stage assignment for an ordered block list."""
+
+  def __init__(self, policy: Optional[str] = None,
+               num_stages: Optional[int] = None):
+    cfg = Env.get().config
+    self.policy = policy or cfg.auto.stage_policy
+    self.num_stages = num_stages or cfg.pipeline.num_stages
+
+  def search(self, block_names: Sequence[str],
+             block_params: Optional[Dict[str, int]] = None,
+             block_flops: Optional[Dict[str, float]] = None
+             ) -> List[List[str]]:
+    """Returns num_stages lists of block names."""
+    names = list(block_names)
+    if self.num_stages <= 1:
+      return [names]
+    if self.policy == "balance_flops" and block_flops:
+      return partition_stages(names, self.num_stages, block_flops)
+    if self.policy == "repeated_layers":
+      groups = find_repeated_blocks(names)
+      # Dominant repeated family carries the FLOPs; balance it and glue
+      # non-repeated prologue/epilogue blocks to first/last stage.
+      family = max(groups.values(), key=len)
+      if len(family) >= self.num_stages:
+        stages = partition_stages(family, self.num_stages,
+                                  block_params)
+        prologue = names[:names.index(family[0])]
+        epilogue = names[names.index(family[-1]) + 1:]
+        stages[0] = prologue + stages[0]
+        stages[-1] = stages[-1] + epilogue
+        return stages
+      get_logger().warning(
+          "repeated_layers policy found only %d repeated blocks for %d "
+          "stages; falling back to balance_param", len(family),
+          self.num_stages)
+    weights = block_params or {}
+    return partition_stages(names, self.num_stages, weights)
+
+  def search_from_params(self, params_by_block: Dict[str, dict],
+                         ) -> List[List[str]]:
+    """Stage search weighted by actual per-block parameter counts."""
+    weights = {name: float(tree_param_count(tree))
+               for name, tree in params_by_block.items()}
+    return self.search(list(params_by_block), block_params=weights)

@@ -1,0 +1,13 @@
+from easyparallellibrary_tpu.profiler.flops import (
+    FlopsProfiler, compiled_cost, estimate_mfu, peak_flops_per_chip,
+)
+from easyparallellibrary_tpu.profiler.memory import (
+    MemoryProfiler, device_memory_stats, compiled_memory,
+)
+from easyparallellibrary_tpu.profiler.profiler import StepProfiler
+
+__all__ = [
+    "FlopsProfiler", "compiled_cost", "estimate_mfu", "peak_flops_per_chip",
+    "MemoryProfiler", "device_memory_stats", "compiled_memory",
+    "StepProfiler",
+]

@@ -1,0 +1,96 @@
+"""FLOPs profiling and MFU accounting.
+
+Analog of the reference's FLOPs profiler (epl/profiler/flops.py): the
+reference registers custom FLOPs formulas for TF ops missing statistics
+(:34-117) and reads RunMetadata traces (:120-158).  On TPU, XLA itself is
+the cost model: `Compiled.cost_analysis()` reports the flops of the
+*optimized* program, so no per-op registry is needed; the hook reports
+GFLOPs/step and model FLOPs utilization against the chip's peak.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from easyparallellibrary_tpu.utils.logging import get_logger
+
+# Peak bf16 FLOP/s per chip by device kind (public TPU specs).
+PEAK_FLOPS = {
+    "TPU v2": 46e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def peak_flops_per_chip(device: Optional[jax.Device] = None) -> float:
+  device = device or jax.devices()[0]
+  kind = device.device_kind
+  for name, flops in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+    if kind.startswith(name):
+      return flops
+  get_logger().warning("unknown device kind %r; assuming 197 TFLOP/s", kind)
+  return 197e12
+
+
+def compiled_cost(fn: Callable, *args, **kwargs) -> Dict[str, float]:
+  """XLA cost analysis of `fn(*args)`: flops, bytes accessed, etc."""
+  lowered = jax.jit(fn).lower(*args, **kwargs)
+  compiled = lowered.compile()
+  cost = compiled.cost_analysis()
+  if isinstance(cost, list):  # some backends return a per-computation list
+    cost = cost[0] if cost else {}
+  return dict(cost or {})
+
+
+def estimate_mfu(flops_per_step: float, step_time_s: float,
+                 n_chips: Optional[int] = None) -> float:
+  n_chips = n_chips or len(jax.devices())
+  achieved = flops_per_step / max(step_time_s, 1e-12)
+  return achieved / (peak_flops_per_chip() * n_chips)
+
+
+class FlopsProfiler:
+  """Per-step GFLOPs/MFU reporter (reference FlopsProfilerHook,
+  epl/profiler/flops.py:120-158: capture once, then log per scope)."""
+
+  def __init__(self, flops_per_step: Optional[float] = None,
+               every_n_steps: int = 100):
+    self.flops_per_step = flops_per_step
+    self.every_n_steps = every_n_steps
+    self._t0 = None
+    self._step0 = 0
+    self._step = 0
+
+  def measure_from(self, fn: Callable, *args, **kwargs):
+    """Fill flops_per_step from XLA's cost model."""
+    cost = compiled_cost(fn, *args, **kwargs)
+    self.flops_per_step = float(cost.get("flops", 0.0))
+    return self.flops_per_step
+
+  def step(self) -> Optional[Dict[str, float]]:
+    """Call once per training step; returns stats every n steps."""
+    now = time.perf_counter()
+    self._step += 1
+    if self._t0 is None:
+      self._t0 = now
+      self._step0 = self._step
+      return None
+    if (self._step - self._step0) % self.every_n_steps != 0:
+      return None
+    dt = (now - self._t0) / (self._step - self._step0)
+    self._t0, self._step0 = now, self._step
+    stats = {"step_time_s": dt, "steps_per_sec": 1.0 / dt}
+    if self.flops_per_step:
+      stats["gflops_per_step"] = self.flops_per_step / 1e9
+      stats["mfu"] = estimate_mfu(self.flops_per_step, dt)
+    get_logger().info("flops profiler: %s", stats)
+    return stats

@@ -1,0 +1,86 @@
+"""Memory profiling.
+
+Analog of the reference's MemoryProfilerHook
+(epl/profiler/memory_profiler_hook.py): the reference reconstructs an
+allocation timeline from RunMetadata allocation_records and emits
+CSV/PNG (:32-271).  On TPU the runtime exposes live/peak HBM per device
+(`Device.memory_stats()`), and the compiler reports the static memory
+plan of a compiled step (`Compiled.memory_analysis()`); this module wraps
+both and writes the same kind of per-step CSV.
+"""
+
+from __future__ import annotations
+
+import csv
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from easyparallellibrary_tpu.utils.logging import get_logger
+
+
+def device_memory_stats(device: Optional[jax.Device] = None
+                        ) -> Dict[str, float]:
+  device = device or jax.local_devices()[0]
+  stats = device.memory_stats() or {}
+  return {
+      "bytes_in_use": float(stats.get("bytes_in_use", 0)),
+      "peak_bytes_in_use": float(stats.get("peak_bytes_in_use", 0)),
+      "bytes_limit": float(stats.get("bytes_limit", 0)),
+  }
+
+
+def compiled_memory(fn: Callable, *args, **kwargs) -> Dict[str, float]:
+  """Static memory plan of the compiled step: temp/argument/output bytes."""
+  compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+  mem = compiled.memory_analysis()
+  if mem is None:
+    return {}
+  out = {}
+  for key in ("temp_size_in_bytes", "argument_size_in_bytes",
+              "output_size_in_bytes", "generated_code_size_in_bytes"):
+    out[key] = float(getattr(mem, key, 0) or 0)
+  out["total_bytes"] = (out.get("temp_size_in_bytes", 0)
+                        + out.get("argument_size_in_bytes", 0))
+  return out
+
+
+class MemoryProfiler:
+  """Per-step HBM recorder with CSV export (reference emits CSV+PNG,
+  memory_profiler_hook.py:207-271)."""
+
+  def __init__(self, every_n_steps: int = 10,
+               csv_path: Optional[str] = None):
+    self.every_n_steps = every_n_steps
+    self.csv_path = csv_path
+    self.records: List[Dict[str, float]] = []
+    self._step = 0
+
+  def step(self) -> Optional[Dict[str, float]]:
+    self._step += 1
+    if self._step % self.every_n_steps != 0:
+      return None
+    rec = {"step": self._step, "time": time.time()}
+    for i, dev in enumerate(jax.local_devices()):
+      stats = device_memory_stats(dev)
+      rec[f"dev{i}_bytes_in_use"] = stats["bytes_in_use"]
+      rec[f"dev{i}_peak_bytes"] = stats["peak_bytes_in_use"]
+    self.records.append(rec)
+    return rec
+
+  def peak_bytes(self) -> float:
+    peaks = [v for r in self.records for k, v in r.items()
+             if k.endswith("_peak_bytes")]
+    return max(peaks) if peaks else 0.0
+
+  def dump_csv(self, path: Optional[str] = None):
+    path = path or self.csv_path
+    if not path or not self.records:
+      return
+    keys = sorted({k for r in self.records for k in r})
+    with open(path, "w", newline="") as f:
+      writer = csv.DictWriter(f, fieldnames=keys)
+      writer.writeheader()
+      writer.writerows(self.records)
+    get_logger().info("memory profile written to %s", path)

@@ -1,0 +1,234 @@
+"""Aux subsystem tests: native/python IO, io slicing, profiler, launcher,
+metric merge (reference analogs: estimator_dp_example.py IO tests,
+profiler tests, test_launcher.sh)."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.constants import GraphKeys
+from easyparallellibrary_tpu.io import (
+    RecordReader, native_io_available, shard_files, write_records)
+from easyparallellibrary_tpu.parallel.metrics import (
+    collect_merged, merge_shard_metrics)
+from easyparallellibrary_tpu.profiler import (
+    FlopsProfiler, StepProfiler, compiled_cost, compiled_memory,
+    estimate_mfu)
+
+
+# ---------------------------------------------------------------- IO ----
+
+def _make_files(tmp_path, n_files=4, recs_per_file=5):
+  files = []
+  for i in range(n_files):
+    path = str(tmp_path / f"data_{i}.rec")
+    write_records(path, [f"file{i}_rec{j}".encode()
+                         for j in range(recs_per_file)])
+    files.append(path)
+  return files
+
+
+def test_native_io_built():
+  assert native_io_available(), "run `make build` to compile csrc/"
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_record_roundtrip(tmp_path, use_native):
+  files = _make_files(tmp_path)
+  reader = RecordReader(files, use_native=use_native)
+  got = [r.decode() for r in reader]
+  expected = [f"file{i}_rec{j}" for i in range(4) for j in range(5)]
+  assert got == expected
+
+
+def test_native_matches_python_reader(tmp_path):
+  files = _make_files(tmp_path, n_files=3, recs_per_file=7)
+  native = [r for r in RecordReader(files, use_native=True)]
+  python = [r for r in RecordReader(files, use_native=False)]
+  assert native == python
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_reader_sharding(tmp_path, use_native):
+  files = _make_files(tmp_path, n_files=4)
+  shard0 = [r.decode() for r in RecordReader(
+      files, shard_index=0, num_shards=2, use_native=use_native)]
+  shard1 = [r.decode() for r in RecordReader(
+      files, shard_index=1, num_shards=2, use_native=use_native)]
+  assert all(r.startswith(("file0", "file2")) for r in shard0)
+  assert all(r.startswith(("file1", "file3")) for r in shard1)
+  assert len(shard0) + len(shard1) == 20
+
+
+def test_large_record_grows_buffer(tmp_path):
+  path = str(tmp_path / "big.rec")
+  big = os.urandom(300_000)  # > initial 64KB buffer
+  write_records(path, [b"small", big, b"tail"])
+  got = list(RecordReader([path], use_native=True))
+  assert got == [b"small", big, b"tail"]
+
+
+def test_shard_files_proportional():
+  epl.init()
+  files = [f"f{i}" for i in range(10)]
+  s0 = shard_files(files, 3, 0)
+  s1 = shard_files(files, 3, 1)
+  s2 = shard_files(files, 3, 2)
+  assert s0 + s1 + s2 == files
+  assert [len(s0), len(s1), len(s2)] == [4, 3, 3]
+
+
+def test_shard_files_drop_last():
+  epl.init(epl.Config({"io.drop_last_files": True}))
+  files = [f"f{i}" for i in range(10)]
+  shards = [shard_files(files, 3, i) for i in range(3)]
+  assert [len(s) for s in shards] == [3, 3, 3]
+
+
+def test_shard_files_validation():
+  epl.init()
+  with pytest.raises(ValueError):
+    shard_files(["a"], 2, 2)
+
+
+# ------------------------------------------------------------ profiler --
+
+def test_compiled_cost_reports_flops():
+  def f(x):
+    return x @ x
+
+  x = jnp.ones((128, 128))
+  cost = compiled_cost(f, x)
+  # 2 * 128^3 = 4.2M flops
+  assert cost.get("flops", 0) >= 2 * 128 ** 3 * 0.5
+
+
+def test_compiled_memory_reports_bytes():
+  def f(x):
+    return (x @ x).sum()
+
+  mem = compiled_memory(f, jnp.ones((64, 64)))
+  assert mem.get("argument_size_in_bytes", 0) >= 64 * 64 * 4
+
+
+def test_step_profiler_summary():
+  prof = StepProfiler(flops_per_step=1e9, tokens_per_step=1024, warmup=1)
+  import time
+  for _ in range(4):
+    prof.tick()
+    time.sleep(0.01)
+  s = prof.summary()
+  assert s["step_time_s"] > 0
+  assert s["tokens_per_sec"] > 0
+  assert 0 <= s["mfu"] < 10
+
+
+def test_flops_profiler_measure():
+  prof = FlopsProfiler(every_n_steps=2)
+  flops = prof.measure_from(lambda x: x @ x, jnp.ones((64, 64)))
+  assert flops > 0
+  assert prof.step() is None  # first call only arms the timer
+  assert prof.step() is None
+  stats = prof.step()
+  assert stats is not None and "mfu" in stats
+
+
+# ------------------------------------------------------------- metrics --
+
+def test_collection_merge_in_train_step():
+  import optax
+  from flax import linen as nn
+  from easyparallellibrary_tpu import ops
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state, make_train_step, parallelize)
+
+  env = epl.init()
+  mesh = epl.current_plan().build_mesh()
+
+  class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+      return ops.Dense(1, parallel="none")(x)
+
+  model = Net()
+  x = jnp.ones((16, 4))
+  y = jnp.zeros((16, 1))
+
+  def loss_fn(params, batch, rng):
+    pred = model.apply({"params": params}, batch["x"])
+    err = pred - batch["y"]
+    epl.add_to_collection(jnp.abs(err), GraphKeys.GLOBAL_MEAN_OBJECTS)
+    epl.add_to_collection(jnp.abs(err), GraphKeys.GLOBAL_SUM_OBJECTS)
+    return jnp.mean(err ** 2), {}
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(rng, x)["params"],
+                             tx=optax.sgd(0.1))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+  step = parallelize(make_train_step(loss_fn), mesh, shardings)
+  state, metrics = step(state, {"x": x, "y": y}, jax.random.PRNGKey(1))
+  mean_key = f"{GraphKeys.GLOBAL_MEAN_OBJECTS}_0"
+  sum_key = f"{GraphKeys.GLOBAL_SUM_OBJECTS}_0"
+  assert mean_key in metrics and sum_key in metrics
+  np.testing.assert_allclose(float(metrics[sum_key]),
+                             float(metrics[mean_key]) * 16, rtol=1e-5)
+
+
+def test_merge_shard_metrics():
+  shard_map = jax.shard_map
+  from jax.sharding import PartitionSpec as P
+  env = epl.init()
+  mesh = env.cluster.build_mesh()
+
+  def body(v):
+    return merge_shard_metrics({"m": jnp.mean(v)}, "mean")["m"]
+
+  f = shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P())
+  out = f(jnp.arange(8.0))
+  np.testing.assert_allclose(float(out), 3.5)
+
+
+# ------------------------------------------------------------- launcher --
+
+def test_launcher_local_multiprocess(tmp_path):
+  """Two local processes bootstrap a shared JAX cluster
+  (reference analog: tests/test_launcher.sh, 2 workers x 1 GPU)."""
+  from easyparallellibrary_tpu.utils.launcher import launch_local
+  script = tmp_path / "worker.py"
+  script.write_text(
+      "import os\n"
+      "os.environ['XLA_FLAGS'] = "
+      "'--xla_force_host_platform_device_count=2'\n"
+      "import jax\n"
+      "jax.config.update('jax_platforms', 'cpu')\n"
+      "import sys; sys.path.insert(0, %r)\n"
+      "from easyparallellibrary_tpu.utils.launcher import init_distributed\n"
+      "init_distributed()\n"
+      "assert jax.process_count() == 2, jax.process_count()\n"
+      "assert len(jax.devices()) == 4\n"
+      "print('worker', jax.process_index(), 'ok')\n"
+      % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+  code = launch_local(2, [sys.executable, str(script)],
+                      retries=0, log_dir=str(tmp_path / "logs"))
+  logs = "".join(
+      open(os.path.join(tmp_path, "logs", f)).read()
+      for f in os.listdir(tmp_path / "logs"))
+  assert code == 0, logs
+  assert "worker 0 ok" in logs and "worker 1 ok" in logs
+
+
+def test_launcher_retry_on_failure(tmp_path):
+  from easyparallellibrary_tpu.utils.launcher import launch_local
+  script = tmp_path / "fail.py"
+  script.write_text("import sys; sys.exit(3)\n")
+  code = launch_local(1, [sys.executable, str(script)], retries=1)
+  assert code == 1

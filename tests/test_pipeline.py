@@ -182,3 +182,26 @@ def test_partition_stages_and_repeated_blocks():
   stages = partition_stages([f"block_{i}" for i in range(8)], 4)
   assert [len(s) for s in stages] == [2, 2, 2, 2]
   assert stages[0] == ["block_0", "block_1"]
+
+
+def test_auto_stage_generator_policies():
+  from easyparallellibrary_tpu.parallel.planner import AutoStageGenerator
+
+  epl.init(epl.Config({"auto.auto_parallel": True,
+                       "pipeline.num_stages": 2}))
+  names = ["embed"] + [f"block_{i}" for i in range(6)] + ["head"]
+  params = {n: 100 for n in names}
+  params["embed"] = 500
+  params["head"] = 500
+
+  gen = AutoStageGenerator(policy="balance_param")
+  stages = gen.search(names, block_params=params)
+  assert len(stages) == 2
+  assert sum(len(s) for s in stages) == len(names)
+  w = [sum(params[n] for n in s) for s in stages]
+  assert max(w) <= 900  # balanced: each side keeps one heavy end
+
+  gen2 = AutoStageGenerator(policy="repeated_layers", num_stages=2)
+  stages2 = gen2.search(names, block_params=params)
+  assert stages2[0][0] == "embed" and stages2[-1][-1] == "head"
+  assert len(stages2) == 2
