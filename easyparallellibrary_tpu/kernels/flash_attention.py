@@ -1,0 +1,290 @@
+"""Flash attention — Pallas TPU kernel.
+
+The framework's hot-op kernel layer (the role the reference's csrc/ plays
+for communication, played here for compute): attention without
+materializing the [S, S] score matrix in HBM.  Forward and backward are
+blockwise with online softmax, keeping tiles in VMEM and feeding the MXU
+with [block, d] matmuls.
+
+Algorithm: FlashAttention-2 style.  Forward saves (out, logsumexp);
+backward recomputes P blockwise from (q, k, lse) — one kernel produces
+dk/dv (grid over KV blocks), another dq (grid over Q blocks).
+
+Used by models via ``attn_impl="pallas_flash"`` and as the local block of
+ring attention.  Off-TPU the kernels run in Pallas interpreter mode so
+tests exercise identical code paths on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+  return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------- forward --
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                causal: bool, scale: float):
+  bq, d = q_ref.shape[2], q_ref.shape[3]
+  seq = k_ref.shape[2]
+  qi = pl.program_id(2)
+  q = q_ref[0, 0].astype(jnp.float32) * scale            # [BQ, D]
+
+  num_kv = seq // block_k
+  if causal:
+    # Only KV blocks at or before this Q block's diagonal participate.
+    hi = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, num_kv)
+  else:
+    hi = num_kv
+
+  def body(j, carry):
+    m, l, acc = carry
+    kblk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    vblk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [BQ, BK]
+    if causal:
+      q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
+                                                 (bq, block_k), 0)
+      k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                     (bq, block_k), 1)
+      s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    new_m = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - new_m[:, None])
+    corr = jnp.exp(m - new_m)
+    l = l * corr + jnp.sum(p, axis=-1)
+    acc = acc * corr[:, None] + jax.lax.dot_general(
+        p, vblk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return new_m, l, acc
+
+  m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+  l0 = jnp.zeros((bq,), jnp.float32)
+  acc0 = jnp.zeros((bq, d), jnp.float32)
+  m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+
+  l_safe = jnp.maximum(l, 1e-30)
+  o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+  # TPU tiling wants the last two dims (8, 128)-aligned, so the [BQ]
+  # logsumexp row is broadcast across 8 sublanes: lse has shape
+  # [B, H, 8, S].
+  lse = (m + jnp.log(l_safe)).astype(jnp.float32)
+  lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, bq))
+
+
+def _fwd(q, k, v, causal: bool, block_q: int, block_k: int):
+  B, H, S, D = q.shape
+  block_q = min(block_q, S)
+  block_k = min(block_k, S)
+  scale = 1.0 / np.sqrt(D)
+  grid = (B, H, S // block_q)
+
+  out, lse = pl.pallas_call(
+      functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
+                        scale=scale),
+      grid=grid,
+      in_specs=[
+          pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+          pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+          pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+      ],
+      out_specs=[
+          pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+          pl.BlockSpec((1, 1, 8, block_q), lambda b, h, i: (b, h, 0, i)),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+          jax.ShapeDtypeStruct((B, H, 8, S), jnp.float32),
+      ],
+      interpret=_interpret(),
+  )(q, k, v)
+  return out, lse
+
+
+# -------------------------------------------------------------- backward --
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, causal: bool,
+                    scale: float):
+  bk, d = k_ref.shape[2], k_ref.shape[3]
+  seq = q_ref.shape[2]
+  ki = pl.program_id(2)
+  kblk = k_ref[0, 0].astype(jnp.float32)                  # [BK, D]
+  vblk = v_ref[0, 0].astype(jnp.float32)
+
+  num_q = seq // block_q
+  lo = (ki * bk) // block_q if causal else 0
+
+  def body(i, carry):
+    dk, dv = carry
+    qblk = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(
+        jnp.float32) * scale                              # [BQ, D]
+    doblk = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+    lse = lse_ref[0, 0, 0, pl.ds(i * block_q, block_q)]      # [BQ]
+    delta = delta_ref[0, 0, 0, pl.ds(i * block_q, block_q)]  # [BQ]
+    s = jax.lax.dot_general(qblk, kblk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [BQ, BK]
+    if causal:
+      q_pos = i * block_q + jax.lax.broadcasted_iota(
+          jnp.int32, (block_q, bk), 0)
+      k_pos = ki * bk + jax.lax.broadcasted_iota(
+          jnp.int32, (block_q, bk), 1)
+      s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])                         # [BQ, BK]
+    dv = dv + jax.lax.dot_general(p, doblk, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(doblk, vblk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None])                        # [BQ, BK]
+    dk = dk + jax.lax.dot_general(ds, qblk, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    return dk, dv
+
+  dk0 = jnp.zeros((bk, d), jnp.float32)
+  dv0 = jnp.zeros((bk, d), jnp.float32)
+  dk, dv = jax.lax.fori_loop(lo, num_q, body, (dk0, dv0))
+  dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+  dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, block_k: int, causal: bool, scale: float):
+  bq, d = q_ref.shape[2], q_ref.shape[3]
+  seq = k_ref.shape[2]
+  qi = pl.program_id(2)
+  qblk = q_ref[0, 0].astype(jnp.float32) * scale
+  doblk = do_ref[0, 0].astype(jnp.float32)
+  lse = lse_ref[0, 0, 0]
+  delta = delta_ref[0, 0, 0]
+
+  num_kv = seq // block_k
+  hi = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k,
+                   num_kv) if causal else num_kv
+
+  def body(j, dq):
+    kblk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    vblk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    s = jax.lax.dot_general(qblk, kblk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+      q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
+                                                 (bq, block_k), 0)
+      k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                     (bq, block_k), 1)
+      s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    dp = jax.lax.dot_general(doblk, vblk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None])
+    return dq + jax.lax.dot_general(ds, kblk, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+
+  dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+  dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd(causal, block_q, block_k, residuals, dout):
+  q, k, v, out, lse = residuals
+  B, H, S, D = q.shape
+  bq = min(block_q, S)
+  bk = min(block_k, S)
+  scale = 1.0 / np.sqrt(D)
+  # delta = rowsum(dO * O) — cheap elementwise, plain XLA.  Broadcast
+  # across 8 sublanes to match the lse tiling layout.
+  delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                  axis=-1)                                 # [B, H, S]
+  delta = jnp.broadcast_to(delta[:, :, None, :],
+                           (B, H, 8, S)).copy()            # [B, H, 8, S]
+
+  dk, dv = pl.pallas_call(
+      functools.partial(_bwd_dkv_kernel, block_q=bq, causal=causal,
+                        scale=scale),
+      grid=(B, H, S // bk),
+      in_specs=[
+          pl.BlockSpec((1, 1, S, D), lambda b, h, j: (b, h, 0, 0)),
+          pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+          pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+          pl.BlockSpec((1, 1, S, D), lambda b, h, j: (b, h, 0, 0)),
+          pl.BlockSpec((1, 1, 8, S), lambda b, h, j: (b, h, 0, 0)),
+          pl.BlockSpec((1, 1, 8, S), lambda b, h, j: (b, h, 0, 0)),
+      ],
+      out_specs=[
+          pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+          pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+          jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+      ],
+      interpret=_interpret(),
+  )(q, k, v, dout, lse, delta)
+
+  dq = pl.pallas_call(
+      functools.partial(_bwd_dq_kernel, block_k=bk, causal=causal,
+                        scale=scale),
+      grid=(B, H, S // bq),
+      in_specs=[
+          pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+          pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+          pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+          pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+          pl.BlockSpec((1, 1, 8, bq), lambda b, h, i: (b, h, 0, i)),
+          pl.BlockSpec((1, 1, 8, bq), lambda b, h, i: (b, h, 0, i)),
+      ],
+      out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+      out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+      interpret=_interpret(),
+  )(q, k, v, dout, lse, delta)
+  return dq, dk, dv
+
+
+# ------------------------------------------------------------ public API --
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+  out, _ = _fwd(q, k, v, causal, block_q, block_k)
+  return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+  out, lse = _fwd(q, k, v, causal, block_q, block_k)
+  return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, residuals, dout):
+  return _bwd(causal, block_q, block_k, residuals, dout)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 128, block_k: int = 128):
+  """Flash attention over [B, S, H, D] inputs (models' layout).
+
+  The scale 1/sqrt(D) is applied inside the kernel.  Sequence length must
+  divide the block sizes (or be smaller, in which case one block is used).
+  """
+  B, S, H, D = q.shape
+  bq = min(block_q, S)
+  bk = min(block_k, S)
+  if S % bq or S % bk:
+    raise ValueError(f"seq len {S} must divide block sizes ({bq}, {bk})")
+  # Kernels use [B, H, S, D] layout.
+  qt = q.transpose(0, 2, 1, 3)
+  kt = k.transpose(0, 2, 1, 3)
+  vt = v.transpose(0, 2, 1, 3)
+  out = _flash(qt, kt, vt, causal, bq, bk)
+  return out.transpose(0, 2, 1, 3)
