@@ -49,7 +49,8 @@ class GPTConfig:
   remat_policy: str = "nothing"      # nothing | dots | everything
   tie_embeddings: bool = True
   z_loss: float = 0.0
-  # MoE (expert parallelism): every `moe_every`-th block uses experts.
+  # MoE (expert parallelism): every `moe_every`-th block uses experts
+  # (moe_every=1 -> every block, =2 -> blocks 1,3,5..., as in Switch).
   num_experts: int = 0
   moe_every: int = 2
   capacity_factor: float = 1.25
@@ -174,7 +175,8 @@ class StageBlocks(nn.Module):
   def __call__(self, x):
     cfg = self.cfg
     for i in range(self.blocks_per_stage):
-      use_moe = cfg.num_experts > 0 and (i % cfg.moe_every == 1)
+      use_moe = cfg.num_experts > 0 and \
+          (i % cfg.moe_every == cfg.moe_every - 1)
       x = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
     return x
 
@@ -232,7 +234,8 @@ class GPT(nn.Module):
             Block, policy=_remat_policy(cfg.remat_policy),
             prevent_cse=False)
       for i in range(cfg.num_layers):
-        use_moe = cfg.num_experts > 0 and (i % cfg.moe_every == 1)
+        use_moe = cfg.num_experts > 0 and \
+          (i % cfg.moe_every == cfg.moe_every - 1)
         x = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x)
 
     x = LayerNorm(dtype=cfg.dtype, name="ln_f")(x)

@@ -71,6 +71,7 @@ class MoEMLP(nn.Module):
     # --- Top-k dispatch mask with capacity -------------------------------
     dispatch_list = []
     combine_list = []
+    assign_list = []      # pre-capacity router choices (for the aux loss)
     remaining = probs
     # Running per-expert fill across the k choices.
     fill = jnp.zeros((E,), jnp.int32)
@@ -78,6 +79,7 @@ class MoEMLP(nn.Module):
       gate = jnp.max(remaining, axis=-1)                   # [T]
       idx = jnp.argmax(remaining, axis=-1)                 # [T]
       onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)     # [T, E]
+      assign_list.append(onehot)
       # Position of each token within its expert queue (0-based), offset
       # by tokens already placed in earlier choices.
       pos = jnp.cumsum(onehot, axis=0) * onehot - onehot + fill[None, :]
@@ -118,8 +120,10 @@ class MoEMLP(nn.Module):
     out = jnp.einsum("ecd,tec->td", expert_out, combine_mask)
 
     # --- Load-balancing aux loss (Switch eq. 4) --------------------------
+    # Uses the router's PRE-capacity assignments: with post-drop counts,
+    # the worse the overflow, the weaker the penalty would look.
     frac_tokens = jnp.mean(
-        sum(dispatch_list).sum(-1).astype(jnp.float32), axis=0)   # [E]
+        sum(assign_list).astype(jnp.float32), axis=0)             # [E]
     frac_probs = jnp.mean(probs, axis=0)                          # [E]
     aux = E * jnp.sum(frac_tokens * frac_probs)
     self.sow("losses", "moe_aux_loss", aux,

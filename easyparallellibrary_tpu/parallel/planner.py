@@ -46,17 +46,19 @@ class AutoStageGenerator:
       return partition_stages(names, self.num_stages, block_flops)
     if self.policy == "repeated_layers":
       groups = find_repeated_blocks(names)
-      # Dominant repeated family carries the FLOPs; balance it and glue
-      # non-repeated prologue/epilogue blocks to first/last stage.
+      # Dominant repeated family sets the cut points, but stages must
+      # cover EVERY block: cut the full ordered list at the positions of
+      # the chosen family members, so interleaved non-family blocks stay
+      # attached to their neighbourhood.
       family = max(groups.values(), key=len)
       if len(family) >= self.num_stages:
-        stages = partition_stages(family, self.num_stages,
-                                  block_params)
-        prologue = names[:names.index(family[0])]
-        epilogue = names[names.index(family[-1]) + 1:]
-        stages[0] = prologue + stages[0]
-        stages[-1] = stages[-1] + epilogue
-        return stages
+        fam_stages = partition_stages(family, self.num_stages, block_params)
+        # Index in `names` where each stage's first family member sits.
+        cut_points = [names.index(s[0]) for s in fam_stages]
+        cut_points[0] = 0
+        cut_points.append(len(names))
+        return [names[cut_points[s]:cut_points[s + 1]]
+                for s in range(self.num_stages)]
       get_logger().warning(
           "repeated_layers policy found only %d repeated blocks for %d "
           "stages; falling back to balance_param", len(family),

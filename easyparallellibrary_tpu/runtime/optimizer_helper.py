@@ -36,33 +36,39 @@ def apply_grad_group(tx, params, grads, opt_state, num_apply_group: int):
   the reference has the same constraint); global-norm optimizers must use
   group count 1.
   """
+  import optax
   if num_apply_group <= 1:
     updates, new_state = tx.update(grads, opt_state, params)
-    import optax
     return optax.apply_updates(params, updates), new_state
 
-  import optax
   flat_params, treedef = jax.tree_util.tree_flatten(params)
-  flat_grads = jax.tree_util.tree_leaves(grads)
+  flat_grads, grads_def = jax.tree_util.tree_flatten(grads)
   groups = _group_leaves(params, num_apply_group)
 
-  # Run the full update once to get new opt state (leafwise it equals the
-  # grouped result for per-leaf optimizers), then rebuild params group by
-  # group with barriers so XLA materializes one group at a time.
-  updates, new_state = tx.update(grads, opt_state, params)
-  flat_updates = jax.tree_util.tree_leaves(updates)
-
+  # One tx.update per group, serialized: each group's gradient inputs pass
+  # through an optimization barrier that depends on the previous group's
+  # result, so the calls cannot be CSE'd or overlapped, and dead-code
+  # elimination trims each call to its group's leaves.  Peak memory is one
+  # group's update tensors, not all of them.
   new_flat = list(flat_params)
   barrier_token = None
-  for group in groups:
-    group_updates = [flat_updates[i] for i in group]
+  new_state = None
+  for gi, group in enumerate(groups):
+    g_leaves = flat_grads
     if barrier_token is not None:
-      # Serialize: this group's inputs wait on the previous group.
-      group_updates = list(jax.lax.optimization_barrier(
-          tuple(group_updates) + (barrier_token,)))[:-1]
-    for gi, i in enumerate(group):
-      new_flat[i] = flat_params[i] + group_updates[gi]
+      chained = jax.lax.optimization_barrier(
+          tuple(flat_grads) + (barrier_token,))
+      g_leaves = list(chained[:-1])
+    grads_g = jax.tree_util.tree_unflatten(grads_def, g_leaves)
+    updates_g, state_g = tx.update(grads_g, opt_state, params)
+    flat_updates = jax.tree_util.tree_leaves(updates_g)
+    for i in group:
+      new_flat[i] = flat_params[i] + flat_updates[i]
     barrier_token = new_flat[group[-1]]
+    if gi == len(groups) - 1:
+      # Only the final call's opt state is consumed; earlier calls' state
+      # outputs are dead and DCE'd.
+      new_state = state_g
 
   new_params = jax.tree_util.tree_unflatten(treedef, new_flat)
   return new_params, new_state

@@ -52,18 +52,34 @@ def save_checkpoint(directory: str, tree, step: Optional[int] = None,
   Returns the checkpoint path.  Leaves are fetched and written bucket by
   bucket (≤ `shard_mb`, default 50 MB — reference saver.py:148) so host
   memory stays bounded.
+
+  Multi-host: EVERY process must call this (arrays sharded across hosts
+  are all-gathered collectively); only process 0 writes, and all
+  processes synchronize before returning so a follow-up restore cannot
+  race the write.
   """
-  if jax.process_index() != 0:
-    return directory
+  multihost = jax.process_count() > 1
+  is_leader = jax.process_index() == 0
   shard_mb = shard_mb or constants.DEFAULT_SAVE_SHARD_MB
   limit = shard_mb * 1024 * 1024
-  os.makedirs(directory, exist_ok=True)
+  if is_leader:
+    os.makedirs(directory, exist_ok=True)
 
   flat = tree_paths_and_leaves(_unbox(tree))
   index: Dict[str, Any] = {"step": step, "leaves": {}, "shards": []}
   bucket: List[Tuple[str, Any]] = []
   bucket_bytes = 0
   shard_id = 0
+
+  def fetch(leaf) -> np.ndarray:
+    if multihost and isinstance(leaf, jax.Array) and \
+        not leaf.is_fully_addressable:
+      # Collective: every process participates in gathering the global
+      # value; only the leader keeps it.
+      from jax.experimental import multihost_utils
+      return np.asarray(multihost_utils.process_allgather(
+          leaf, tiled=True))
+    return np.asarray(jax.device_get(leaf))
 
   def flush():
     nonlocal bucket, bucket_bytes, shard_id
@@ -72,12 +88,13 @@ def save_checkpoint(directory: str, tree, step: Optional[int] = None,
     fname = f"shard_{shard_id:05d}.npz"
     arrays = {}
     for path, leaf in bucket:
-      host = np.asarray(jax.device_get(leaf))
+      host = fetch(leaf)
       arrays[path] = host
       index["leaves"][path] = {
           "shard": fname, "shape": list(host.shape),
           "dtype": str(host.dtype)}
-    np.savez(os.path.join(directory, fname), **arrays)
+    if is_leader:
+      np.savez(os.path.join(directory, fname), **arrays)
     index["shards"].append(fname)
     shard_id += 1
     bucket, bucket_bytes = [], 0
@@ -91,10 +108,14 @@ def save_checkpoint(directory: str, tree, step: Optional[int] = None,
     bucket_bytes += nbytes
   flush()
 
-  with open(os.path.join(directory, INDEX_FILE), "w") as f:
-    json.dump(index, f, indent=1)
-  get_logger().info("saved checkpoint: %s (%d leaves, %d shards)",
-                    directory, len(index["leaves"]), shard_id)
+  if is_leader:
+    with open(os.path.join(directory, INDEX_FILE), "w") as f:
+      json.dump(index, f, indent=1)
+    get_logger().info("saved checkpoint: %s (%d leaves, %d shards)",
+                      directory, len(index["leaves"]), shard_id)
+  if multihost:
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(f"epl_save_{directory}")
   return directory
 
 

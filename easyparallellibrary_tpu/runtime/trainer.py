@@ -76,19 +76,24 @@ def build_train_step(loss_fn: Callable,
     if scaled:
       finite = amp_lib.all_finite(grads)
       new_scale = state.loss_scale.update(finite)
-      # Skip the update on overflow (reference conditional apply,
-      # loss_scale.py:44-51).
-      safe = lambda g, p: jnp.where(finite, g, jnp.zeros_like(g))
-      grads = jax.tree_util.tree_map(
-          lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+      # Run the update, then select the OLD state wholesale on overflow —
+      # a true no-op step (the reference conditionally skips the apply,
+      # loss_scale.py:44-51; applying zeroed grads would still run weight
+      # decay and advance optimizer moments).
       if num_apply_group > 1:
         new_params, new_opt = apply_grad_group(
             state.tx, state.params, grads, state.opt_state, num_apply_group)
-        state = state.replace(step=state.step + 1, params=new_params,
-                              opt_state=new_opt, loss_scale=new_scale)
+        updated = state.replace(step=state.step + 1, params=new_params,
+                                opt_state=new_opt)
       else:
-        state = state.apply_gradients(grads=grads).replace(
-            loss_scale=new_scale)
+        updated = state.apply_gradients(grads=grads)
+      pick = lambda new, old: jax.tree_util.tree_map(
+          lambda a, b: jnp.where(finite, a, b), new, old)
+      state = state.replace(
+          step=jnp.where(finite, updated.step, state.step),
+          params=pick(updated.params, state.params),
+          opt_state=pick(updated.opt_state, state.opt_state),
+          loss_scale=new_scale)
       metrics = {"loss": loss, "loss_scale": new_scale.scale,
                  "grads_finite": finite.astype(jnp.float32)}
     else:

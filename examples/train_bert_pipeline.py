@@ -1,0 +1,66 @@
+"""BERT 2-stage pipeline pretraining (reference analog:
+docs/en/tutorials/pipe.md:33-48 — BERT with 2 replicate scopes and
+num_micro_batch=4; BASELINE config 2)."""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.models import Bert, BertConfig
+from easyparallellibrary_tpu.models.bert import bert_mlm_loss
+from easyparallellibrary_tpu.parallel import (
+    TrainState, create_sharded_train_state, make_train_step, parallelize)
+
+
+def main():
+  p = argparse.ArgumentParser()
+  p.add_argument("--stages", type=int, default=2)
+  p.add_argument("--micro", type=int, default=4)
+  p.add_argument("--layers", type=int, default=4)
+  p.add_argument("--batch", type=int, default=16)
+  p.add_argument("--steps", type=int, default=10)
+  args = p.parse_args()
+
+  env = epl.init(epl.Config({"pipeline.num_micro_batch": args.micro}))
+  for i in range(args.stages):
+    with epl.replicate(1, name=f"stage{i}"):
+      pass
+  mesh = epl.current_plan().build_mesh()
+  print("mesh:", dict(zip(mesh.axis_names, mesh.devices.shape)))
+
+  cfg = BertConfig(
+      vocab_size=8192, num_layers=args.layers, num_heads=8, d_model=256,
+      d_ff=1024, max_seq_len=128,
+      dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+      else jnp.float32,
+      pipeline_stages=args.stages, num_micro_batch=args.micro)
+  model = Bert(cfg)
+
+  r = np.random.RandomState(0)
+  ids = jnp.asarray(r.randint(0, cfg.vocab_size,
+                              (args.batch, cfg.max_seq_len)), jnp.int32)
+  batch = {"ids": ids, "labels": ids,
+           "mask": jnp.asarray(r.rand(args.batch, cfg.max_seq_len) < 0.15,
+                               jnp.float32)}
+
+  def init_fn(rng):
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=model.init(rng, ids)["params"], tx=optax.adamw(1e-4))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+  step = parallelize(
+      make_train_step(lambda p, b, r: bert_mlm_loss(model, p, b, r)),
+      mesh, shardings)
+  for i in range(args.steps):
+    state, m = step(state, batch, jax.random.PRNGKey(1))
+    print(f"step {i}: mlm loss {float(m['loss']):.4f}")
+
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,79 @@
+"""ResNet data-parallel training with an optionally split classifier head
+(reference analog: tests/dnn_data_parallel.py + README.md:58-70's
+large-vocab split example; BASELINE configs 1 and 3)."""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu import ops
+from easyparallellibrary_tpu.models import ResNet, resnet50_config
+from easyparallellibrary_tpu.parallel import (
+    TrainState, create_sharded_train_state, make_train_step, parallelize)
+
+
+def main():
+  p = argparse.ArgumentParser()
+  p.add_argument("--split-head", type=int, default=0,
+                 help="shard the classifier over N devices")
+  p.add_argument("--classes", type=int, default=1000)
+  p.add_argument("--batch", type=int, default=32)
+  p.add_argument("--steps", type=int, default=10)
+  args = p.parse_args()
+
+  env = epl.init()
+  if args.split_head > 1:
+    with epl.split(args.split_head):
+      pass
+  mesh = epl.current_plan().build_mesh()
+  print("mesh:", dict(zip(mesh.axis_names, mesh.devices.shape)))
+
+  cfg = resnet50_config(
+      num_classes=args.classes,
+      dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+      else jnp.float32)
+  model = ResNet(cfg)
+  r = np.random.RandomState(0)
+  x = jnp.asarray(r.randn(args.batch, 64, 64, 3), jnp.float32)
+  y = jnp.asarray(r.randint(0, args.classes, (args.batch,)), jnp.int32)
+
+  def apply_model(params, inputs):
+    if args.split_head > 1:
+      with epl.split(args.split_head):
+        return model.apply({"params": params}, inputs)
+    return model.apply({"params": params}, inputs)
+
+  def init_fn(rng):
+    if args.split_head > 1:
+      with epl.split(args.split_head):
+        params = model.init(rng, x[:1])["params"]
+    else:
+      params = model.init(rng, x[:1])["params"]
+    return TrainState.create(apply_fn=model.apply, params=params,
+                             tx=optax.adam(1e-3))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+
+  def loss_fn(params, batch, rng):
+    logits = apply_model(params, batch["x"])
+    loss = ops.distributed_sparse_softmax_cross_entropy_with_logits(
+        batch["y"], logits)
+    preds = ops.distributed_argmax(logits)
+    acc = jnp.mean(ops.distributed_equal(preds, batch["y"]).astype(
+        jnp.float32))
+    return jnp.mean(loss), {"accuracy": acc}
+
+  step = parallelize(make_train_step(loss_fn), mesh, shardings)
+  for i in range(args.steps):
+    state, m = step(state, {"x": x, "y": y}, jax.random.PRNGKey(1))
+    print(f"step {i}: loss {float(m['loss']):.4f} "
+          f"acc {float(m['accuracy']):.3f}")
+
+
+if __name__ == "__main__":
+  main()

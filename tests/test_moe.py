@@ -112,3 +112,22 @@ def test_moe_aux_loss_near_one_for_balanced():
   _, state = moe.apply(v, x, mutable=["losses"])
   aux = float(jax.tree_util.tree_leaves(state["losses"])[0])
   assert 0.5 < aux < 4.0  # near-uniform at random init
+
+
+def test_moe_every_one_uses_experts_in_all_blocks():
+  cfg = dataclasses.replace(CFG, moe_every=1)
+  model = GPT(cfg)
+  ids = jnp.zeros((2, 5), jnp.int32)
+  params = model.init(jax.random.PRNGKey(0), ids)["params"]
+  assert "moe" in params["block_0"] and "moe" in params["block_1"]
+
+
+def test_moe_aux_loss_sees_pre_drop_imbalance():
+  """With capacity 1, a collapsed router must still show high aux loss."""
+  moe = MoEMLP(dataclasses.replace(CFG, capacity_factor=4 / 16))
+  x = jnp.ones((2, 8, 16), jnp.float32)  # identical tokens -> one expert
+  v = moe.init(jax.random.PRNGKey(0), x)
+  _, state = moe.apply(v, x, mutable=["losses"])
+  aux = float(jax.tree_util.tree_leaves(state["losses"])[0])
+  # All 16 tokens routed to 1 of 4 experts: aux ~= E * 1 * p_max >= 1.
+  assert aux > 1.0

@@ -205,3 +205,14 @@ def test_auto_stage_generator_policies():
   stages2 = gen2.search(names, block_params=params)
   assert stages2[0][0] == "embed" and stages2[-1][-1] == "head"
   assert len(stages2) == 2
+
+
+def test_repeated_layers_policy_covers_all_blocks():
+  from easyparallellibrary_tpu.parallel.planner import AutoStageGenerator
+  epl.init()
+  names = ["emb", "attn_0", "mlp_0", "attn_1", "mlp_1", "head"]
+  gen = AutoStageGenerator(policy="repeated_layers", num_stages=2)
+  stages = gen.search(names)
+  flat = [n for s in stages for n in s]
+  assert flat == names  # contiguous, nothing dropped
+  assert len(stages) == 2

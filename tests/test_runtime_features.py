@@ -121,16 +121,23 @@ def test_loss_scale_skip_on_overflow():
     leaf = jax.tree_util.tree_leaves(params)[0]
     return jnp.sum(leaf) * jnp.inf, {}
 
-  tx = optax.sgd(0.1)
+  # adamw: weight decay would perturb params even with zeroed grads, so
+  # this also guards the true-no-op semantics of the skip.
+  tx = optax.adamw(0.1, weight_decay=0.1)
   state = create_train_state(model.apply, params, tx, config=cfg)
+  opt0 = jax.tree_util.tree_map(lambda x: x, state.opt_state)
   step = build_train_step(inf_loss, config=cfg)
   s0 = float(state.loss_scale.scale)
   state, m = step(state, batch, None)
   assert float(m["grads_finite"]) == 0.0
   assert float(state.loss_scale.scale) == s0 / 2  # backoff
+  assert int(state.step) == 0                     # step not advanced
   jax.tree_util.tree_map(
       lambda a, b: np.testing.assert_allclose(a, b),
-      state.params, params)  # update skipped
+      state.params, params)  # update skipped entirely
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(a, b),
+      state.opt_state, opt0)  # optimizer moments untouched
 
 
 def test_grouped_apply_matches_plain():

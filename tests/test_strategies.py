@@ -137,3 +137,11 @@ def test_split_none_takes_whole_model_axis():
   assert plan.model_parallel == 8
   mesh = plan.build_mesh()
   assert dict(zip(mesh.axis_names, mesh.devices.shape))["model"] == 8
+
+
+def test_named_scopes_in_loop_make_distinct_stages():
+  epl.init()
+  for i in range(3):
+    with epl.replicate(1, name=f"stage{i}"):
+      pass
+  assert epl.current_plan().num_stages == 3
