@@ -7,3 +7,6 @@ __all__ = [
     "shard_files", "shard_batch_dim", "RecordReader", "write_records",
     "native_io_available",
 ]
+from easyparallellibrary_tpu.io.device import DevicePrefetcher, global_batch
+
+__all__ += ["DevicePrefetcher", "global_batch"]

@@ -1,0 +1,75 @@
+"""Host→device input feeding for multi-process runs.
+
+The reference feeds each worker its own input slice through the TF
+runtime; under GSPMD every process holds only its local shard of the
+global batch, and jit expects *global* arrays.  These helpers build them:
+
+  * `global_batch(local_batch, mesh, spec)` — assemble per-process local
+    shards into a global jax.Array (single-process: a plain device_put).
+  * `DevicePrefetcher` — double-buffers an iterator onto the devices so
+    host IO (e.g. `io.RecordReader`) overlaps the training step, the role
+    of the reference's dataset prefetch + `io.prefetch` config.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from easyparallellibrary_tpu import constants
+
+
+def global_batch(local_batch, mesh: Mesh, spec: Optional[P] = None):
+  """Assemble per-process host arrays into global sharded arrays.
+
+  `local_batch` leaves hold THIS process's rows (global_batch_dim =
+  local_rows * process_count when the spec shards the leading dim).
+  """
+  spec = spec if spec is not None else P(constants.DATA_AXIS)
+
+  def put(x):
+    x = np.asarray(x)
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+      return jax.device_put(x, sharding)
+    from jax.experimental import multihost_utils
+    return multihost_utils.host_local_array_to_global_array(
+        x, mesh, spec)
+
+  return jax.tree_util.tree_map(put, local_batch)
+
+
+class DevicePrefetcher:
+  """Wrap a host batch iterator; keeps `depth` batches in flight on
+  device (reference analog: io.prefetch, epl/config.py:62-75)."""
+
+  def __init__(self, iterator: Iterator[Any], mesh: Mesh,
+               spec: Optional[P] = None, depth: int = 2):
+    self._it = iter(iterator)
+    self._mesh = mesh
+    self._spec = spec
+    self._depth = max(1, depth)
+    self._queue: collections.deque = collections.deque()
+
+  def _fill(self):
+    while len(self._queue) < self._depth:
+      try:
+        host = next(self._it)
+      except StopIteration:
+        return
+      self._queue.append(global_batch(host, self._mesh, self._spec))
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    self._fill()
+    if not self._queue:
+      raise StopIteration
+    out = self._queue.popleft()
+    self._fill()
+    return out

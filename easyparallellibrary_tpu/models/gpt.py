@@ -64,6 +64,10 @@ class GPTConfig:
   num_micro_batch: int = 1
   pipeline_schedule: str = "PreferBackward"
   pipeline_debug_sequential: bool = False  # ground-truth path for tests
+  # Interleaved pipelining (reference config pipeline.num_stages_per_device):
+  # blocks split into K chained pipeline passes, so each device holds K
+  # non-adjacent block chunks (the circular weight distribution).
+  pipeline_interleave: int = 1
 
 
 def _act_spec(cfg: GPTConfig, ndim: int = 3) -> P:
@@ -211,22 +215,25 @@ class GPT(nn.Module):
     if cfg.pipeline_stages > 1:
       from easyparallellibrary_tpu.parallel.pipeline import Pipeline
       from easyparallellibrary_tpu.strategies.scheduler import get_scheduler
-      if cfg.num_layers % cfg.pipeline_stages != 0:
+      K = max(1, cfg.pipeline_interleave)
+      chunks = cfg.pipeline_stages * K
+      if cfg.num_layers % chunks != 0:
         raise ValueError(
             f"num_layers={cfg.num_layers} must divide into "
-            f"pipeline_stages={cfg.pipeline_stages} homogeneous stages")
+            f"pipeline_stages*interleave={chunks} homogeneous stages")
       sched = get_scheduler(cfg.pipeline_schedule)
-      x = Pipeline(
-          stage_module_cls=StageBlocks,
-          stage_kwargs=dict(
-              cfg=cfg,
-              blocks_per_stage=cfg.num_layers // cfg.pipeline_stages),
-          num_stages=cfg.pipeline_stages,
-          num_micro_batch=cfg.num_micro_batch,
-          sequential=cfg.pipeline_debug_sequential,
-          remat_stage=sched.remat_stage or cfg.remat,
-          seq_parallel=cfg.seq_parallel,
-          name="pipeline")(x)
+      for k in range(K):
+        x = Pipeline(
+            stage_module_cls=StageBlocks,
+            stage_kwargs=dict(
+                cfg=cfg,
+                blocks_per_stage=cfg.num_layers // chunks),
+            num_stages=cfg.pipeline_stages,
+            num_micro_batch=cfg.num_micro_batch,
+            sequential=cfg.pipeline_debug_sequential,
+            remat_stage=sched.remat_stage or cfg.remat,
+            seq_parallel=cfg.seq_parallel,
+            name="pipeline" if K == 1 else f"pipeline_{k}")(x)
     else:
       block_cls = Block
       if cfg.remat:

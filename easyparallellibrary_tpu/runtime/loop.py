@@ -1,0 +1,77 @@
+"""High-level training loop — `fit` with checkpointing, profiling, and
+auto-resume.
+
+The reference's users get this from MonitoredTrainingSession + hooks
+(checkpoint saver hook, logging hooks, profiler hooks — all intercepted
+in epl/parallel/hooks.py:279-472); here it is an explicit, composable
+loop over the already-parallelized step function.  Restart-after-failure
+is checkpoint-based: `fit` resumes from the newest checkpoint in
+`checkpoint_dir` (the failure-recovery story the reference lacks beyond
+kill-and-retry, SURVEY §5.3).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+
+from easyparallellibrary_tpu.profiler.profiler import StepProfiler
+from easyparallellibrary_tpu.runtime import saver
+from easyparallellibrary_tpu.utils.logging import get_logger
+
+
+def fit(step_fn: Callable,
+        state,
+        data: Iterable[Any],
+        *,
+        num_steps: int,
+        rng=None,
+        checkpoint_dir: str = "",
+        checkpoint_every: int = 0,
+        log_every: int = 50,
+        profiler: Optional[StepProfiler] = None,
+        shardings=None):
+  """Run `num_steps` of `step_fn(state, batch, rng) -> (state, metrics)`.
+
+  `data` yields batches (already global/sharded — see io.DevicePrefetcher).
+  Returns (state, last_metrics).
+  """
+  log = get_logger()
+  rng = rng if rng is not None else jax.random.PRNGKey(0)
+  start_step = int(state.step) if hasattr(state, "step") else 0
+
+  if checkpoint_dir:
+    last = saver.latest_step(checkpoint_dir)
+    if last is not None and last > start_step:
+      log.info("resuming from %s at step %d", checkpoint_dir, last)
+      params, _ = saver.restore_checkpoint(
+          checkpoint_dir, target=state.params,
+          shardings=None if shardings is None else shardings.params)
+      state = state.replace(params=params, step=last)
+      start_step = last
+
+  it = iter(data)
+  metrics: Dict[str, Any] = {}
+  for step_idx in range(start_step, num_steps):
+    try:
+      batch = next(it)
+    except StopIteration:
+      it = iter(data)
+      batch = next(it)
+    state, metrics = step_fn(state, batch, rng)
+    if profiler is not None:
+      profiler.tick()
+    if log_every and (step_idx + 1) % log_every == 0:
+      loss = metrics.get("loss")
+      log.info("step %d: loss %s", step_idx + 1,
+               f"{float(loss):.5f}" if loss is not None else "n/a")
+    if (checkpoint_dir and checkpoint_every
+        and (step_idx + 1) % checkpoint_every == 0):
+      saver.save_checkpoint(checkpoint_dir, state.params,
+                            step=step_idx + 1)
+  if profiler is not None and profiler.summary():
+    log.info("training profile: %s", profiler.summary())
+  return state, metrics

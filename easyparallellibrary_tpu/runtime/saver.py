@@ -45,6 +45,18 @@ def _unbox(tree):
   return nn.unbox(tree)
 
 
+def _rebox_like(template, tree):
+  """Put restored values back inside the template's metadata boxes, so a
+  restored tree is a drop-in replacement for live (boxed) params."""
+  import flax.linen as nn
+  is_box = lambda x: isinstance(x, nn.meta.AxisMetadata)
+  flat_t, tdef = jax.tree_util.tree_flatten(template, is_leaf=is_box)
+  flat_v = jax.tree_util.tree_leaves(tree)
+  out = [t.replace_boxed(v) if is_box(t) else v
+         for t, v in zip(flat_t, flat_v)]
+  return jax.tree_util.tree_unflatten(tdef, out)
+
+
 def save_checkpoint(directory: str, tree, step: Optional[int] = None,
                     shard_mb: Optional[int] = None) -> str:
   """Write `tree` under `directory` (leader process only).
@@ -198,13 +210,14 @@ def restore_checkpoint(directory: str,
   restored = jax.tree_util.tree_unflatten(treedef, new_leaves)
 
   if shardings is not None:
-    import flax.linen as nn
     flat_shard = jax.tree_util.tree_leaves(
         shardings, is_leaf=lambda x: hasattr(x, "spec"))
     flat_restored = jax.tree_util.tree_leaves(restored)
     placed = [jax.device_put(v, s)
               for v, s in zip(flat_restored, flat_shard)]
     restored = jax.tree_util.tree_unflatten(treedef, placed)
+  # Match the target's boxing so restored params drop into a TrainState.
+  restored = _rebox_like(target, restored)
   return restored, index.get("step")
 
 
@@ -214,3 +227,24 @@ def latest_step(directory: str) -> Optional[int]:
       return json.load(f).get("step")
   except FileNotFoundError:
     return None
+
+
+# ----------------------------------------------------------------- orbax --
+
+def save_checkpoint_orbax(directory: str, tree, step: int = 0):
+  """Production multi-host async-capable path via orbax (optional)."""
+  import orbax.checkpoint as ocp
+  ckptr = ocp.StandardCheckpointer()
+  path = os.path.join(os.path.abspath(directory), f"step_{step}")
+  ckptr.save(path, _unbox(tree))
+  ckptr.wait_until_finished()
+  return path
+
+
+def restore_checkpoint_orbax(directory: str, step: int, target=None):
+  import orbax.checkpoint as ocp
+  ckptr = ocp.StandardCheckpointer()
+  path = os.path.join(os.path.abspath(directory), f"step_{step}")
+  if target is not None:
+    return ckptr.restore(path, _unbox(target))
+  return ckptr.restore(path)

@@ -1,0 +1,71 @@
+"""High-level fit loop: training, periodic checkpointing, auto-resume."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu import ops
+from easyparallellibrary_tpu.parallel import (
+    TrainState, create_sharded_train_state, make_train_step, parallelize)
+from easyparallellibrary_tpu.runtime.loop import fit
+from easyparallellibrary_tpu.runtime.saver import latest_step
+
+
+class Net(nn.Module):
+  @nn.compact
+  def __call__(self, x):
+    return ops.Dense(1, parallel="none")(jnp.tanh(
+        ops.Dense(8, parallel="none")(x)))
+
+
+def _setup():
+  env = epl.init()
+  mesh = epl.current_plan().build_mesh()
+  model = Net()
+  r = np.random.RandomState(0)
+  x = jnp.asarray(r.randn(16, 4), jnp.float32)
+  y = jnp.asarray(r.randn(16, 1), jnp.float32)
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(rng, x)["params"],
+                             tx=optax.adam(1e-2))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+
+  def loss_fn(params, batch, rng):
+    pred = model.apply({"params": params}, batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2), {}
+
+  step = parallelize(make_train_step(loss_fn), mesh, shardings)
+  return state, shardings, step, {"x": x, "y": y}
+
+
+def test_fit_trains_and_checkpoints(tmp_path):
+  state, shardings, step, batch = _setup()
+  ckpt = str(tmp_path / "ck")
+  state, metrics = fit(step, state, [batch], num_steps=10,
+                       checkpoint_dir=ckpt, checkpoint_every=5,
+                       log_every=0, shardings=shardings)
+  assert int(state.step) == 10
+  assert latest_step(ckpt) == 10
+  assert np.isfinite(float(metrics["loss"]))
+
+
+def test_fit_resumes_from_checkpoint(tmp_path):
+  state, shardings, step, batch = _setup()
+  ckpt = str(tmp_path / "ck")
+  state, _ = fit(step, state, [batch], num_steps=6, checkpoint_dir=ckpt,
+                 checkpoint_every=3, log_every=0, shardings=shardings)
+  params_after_6 = jax.tree_util.tree_map(np.asarray,
+                                          jax.device_get(state.params))
+
+  # Fresh state (step 0) resumes from the step-6 checkpoint and runs 6..8.
+  state2, shardings2, step2, _ = _setup()
+  state2, _ = fit(step2, state2, [batch], num_steps=8, checkpoint_dir=ckpt,
+                  log_every=0, shardings=shardings2)
+  assert int(state2.step) == 8

@@ -1,0 +1,98 @@
+"""True multi-process data-parallel training: 2 processes x 2 CPU devices
+train the same model and must match a single-process 4-device run
+(reference analog: tests/test_launcher.sh 2-worker DP numeric check)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = '''
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, %(repo)r)
+import jax.numpy as jnp, numpy as np, optax
+from flax import linen as nn
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu import ops
+from easyparallellibrary_tpu.io import global_batch
+from easyparallellibrary_tpu.parallel import (
+    TrainState, create_sharded_train_state, make_train_step, parallelize)
+from easyparallellibrary_tpu.utils.launcher import init_distributed
+
+init_distributed()
+
+class Net(nn.Module):
+  @nn.compact
+  def __call__(self, x):
+    return ops.Dense(1, parallel="none")(jnp.tanh(
+        ops.Dense(8, parallel="none")(x)))
+
+env = epl.init()
+mesh = epl.current_plan().build_mesh()
+
+# Global deterministic dataset of 16 rows; each process feeds its half.
+r = np.random.RandomState(0)
+X = r.randn(16, 4).astype(np.float32)
+Y = (X @ r.randn(4, 1)).astype(np.float32)
+pid, pc = jax.process_index(), jax.process_count()
+lo, hi = pid * 16 // pc, (pid + 1) * 16 // pc
+local = {"x": X[lo:hi], "y": Y[lo:hi]}
+batch = global_batch(local, mesh)
+
+model = Net()
+
+def init_fn(rng):
+  return TrainState.create(apply_fn=model.apply,
+                           params=model.init(rng, jnp.zeros((1, 4)))["params"],
+                           tx=optax.sgd(0.1))
+
+state, shardings = create_sharded_train_state(
+    init_fn, mesh, jax.random.PRNGKey(0))
+
+def loss_fn(params, b, rng):
+  pred = model.apply({"params": params}, b["x"])
+  return jnp.mean((pred - b["y"]) ** 2), {}
+
+step = parallelize(make_train_step(loss_fn), mesh, shardings)
+for i in range(5):
+  state, m = step(state, batch, jax.random.PRNGKey(1))
+  if jax.process_index() == 0:
+    print(f"LOSS {i} {float(m['loss']):.8f}")
+'''
+
+
+def _run_single():
+  """Reference run: 1 process, 4 devices."""
+  script = WORKER % {"repo": REPO}
+  env = dict(os.environ)
+  env.pop("EPL_COORDINATOR_ADDRESS", None)
+  env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+  script = script.replace("device_count=2", "device_count=4")
+  out = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=300)
+  assert out.returncode == 0, out.stderr[-2000:]
+  return [float(l.split()[2]) for l in out.stdout.splitlines()
+          if l.startswith("LOSS")]
+
+
+def test_two_process_dp_matches_single_process(tmp_path):
+  from easyparallellibrary_tpu.utils.launcher import launch_local
+  script_path = tmp_path / "worker.py"
+  script_path.write_text(WORKER % {"repo": REPO})
+  code = launch_local(2, [sys.executable, str(script_path)],
+                      retries=0, log_dir=str(tmp_path / "logs"))
+  logs = ""
+  for f in sorted(os.listdir(tmp_path / "logs")):
+    logs += open(os.path.join(tmp_path, "logs", f)).read()
+  assert code == 0, logs[-2000:]
+  multi = [float(l.split()[2]) for l in logs.splitlines()
+           if l.startswith("LOSS")]
+  assert len(multi) == 5, logs[-2000:]
+  single = _run_single()
+  np.testing.assert_allclose(multi, single, rtol=1e-5, atol=1e-7)

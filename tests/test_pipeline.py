@@ -216,3 +216,23 @@ def test_repeated_layers_policy_covers_all_blocks():
   flat = [n for s in stages for n in s]
   assert flat == names  # contiguous, nothing dropped
   assert len(stages) == 2
+
+
+def test_gpt_interleaved_pipeline_matches_sequential():
+  from easyparallellibrary_tpu.models import GPT, GPTConfig
+  from easyparallellibrary_tpu.models.gpt import gpt_loss
+
+  env = epl.init()
+  mesh = env.cluster.build_mesh(stage=2)
+  base = dict(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+              d_ff=64, max_seq_len=16, dtype=jnp.float32,
+              pipeline_stages=2, num_micro_batch=2, pipeline_interleave=2)
+  pp = GPT(GPTConfig(**base))
+  seq = GPT(GPTConfig(**base, pipeline_debug_sequential=True))
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (4, 17)),
+                    jnp.int32)
+  params = pp.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+  assert "pipeline_0" in params and "pipeline_1" in params
+  l_pp, _ = jax.jit(lambda p: gpt_loss(pp, p, {"ids": ids}))(params)
+  l_seq, _ = jax.jit(lambda p: gpt_loss(seq, p, {"ids": ids}))(params)
+  np.testing.assert_allclose(float(l_pp), float(l_seq), rtol=1e-5)

@@ -57,7 +57,7 @@ def test_roundtrip(tmp_path):
   assert latest_step(path) == 7
   jax.tree_util.tree_map(
       lambda a, b: np.testing.assert_allclose(a, b),
-      nn.unbox(state.params), restored)
+      nn.unbox(state.params), nn.unbox(restored))
 
 
 def test_small_shard_buckets(tmp_path):
@@ -70,7 +70,7 @@ def test_small_shard_buckets(tmp_path):
   restored, _ = restore_checkpoint(path, target=state.params)
   jax.tree_util.tree_map(
       lambda a, b: np.testing.assert_allclose(a, b),
-      nn.unbox(state.params), restored)
+      nn.unbox(state.params), nn.unbox(restored))
 
 
 def test_restore_with_resharding_to_tp_mesh(tmp_path):
@@ -81,15 +81,11 @@ def test_restore_with_resharding_to_tp_mesh(tmp_path):
   mesh2, state2, shardings2 = _state(tp=True)
   restored, _ = restore_checkpoint(
       path, target=state2.params, shardings=shardings2.params)
-  kernel = jax.tree_util.tree_leaves(restored)[1]  # kernel after bias
-  flatvals = {k: v for k, v in zip(
-      ["bias", "kernel"],
-      jax.tree_util.tree_leaves(restored))}
-  k = flatvals["kernel"]
+  k = nn.unbox(restored)["Dense_0"]["kernel"]
   assert k.sharding.shard_shape(k.shape)[1] == k.shape[1] // 8
   jax.tree_util.tree_map(
       lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b)),
-      nn.unbox(state.params), restored)
+      nn.unbox(state.params), nn.unbox(restored))
 
 
 def test_assign_map_rename(tmp_path):
@@ -99,7 +95,7 @@ def test_assign_map_rename(tmp_path):
   renamed = {"renamed": nn.unbox(state.params)["Dense_0"]}
   restored, _ = restore_checkpoint(
       path, target=renamed, assign_map={r"^renamed/": "Dense_0/"})
-  np.testing.assert_allclose(restored["renamed"]["kernel"],
+  np.testing.assert_allclose(nn.unbox(restored)["renamed"]["kernel"],
                              nn.unbox(state.params)["Dense_0"]["kernel"])
 
 
@@ -121,3 +117,15 @@ def test_missing_tensor_error(tmp_path):
   path = save_checkpoint(str(tmp_path / "ckpt"), state.params)
   with pytest.raises(KeyError):
     restore_checkpoint(path, target={"nope": jnp.zeros((1,))})
+
+
+def test_orbax_roundtrip(tmp_path):
+  from easyparallellibrary_tpu.runtime.saver import (
+      restore_checkpoint_orbax, save_checkpoint_orbax)
+  mesh, state, shardings = _state()
+  path = save_checkpoint_orbax(str(tmp_path / "ock"), state.params, step=3)
+  restored = restore_checkpoint_orbax(str(tmp_path / "ock"), 3,
+                                      target=state.params)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b)),
+      nn.unbox(state.params), restored)
