@@ -107,6 +107,12 @@ def main():
   achieved = tokens_per_sec * flops_per_token / n_chips
   mfu = achieved / peak_flops_per_chip() if on_tpu else 0.0
 
+  try:
+    mem = jax.local_devices()[0].memory_stats() or {}
+    peak_hbm_gb = round(mem.get("peak_bytes_in_use", 0) / 2 ** 30, 2)
+  except Exception:
+    peak_hbm_gb = None
+
   result = {
       "metric": "gpt350m_train_mfu" if on_tpu else "gpt_smoke_tokens_per_sec",
       "value": round(mfu, 4) if on_tpu else round(tokens_per_sec, 1),
@@ -118,6 +124,7 @@ def main():
           "n_chips": n_chips,
           "device": jax.devices()[0].device_kind,
           "loss": round(float(metrics["loss"]), 4),
+          "peak_hbm_gb": peak_hbm_gb,
       },
   }
   print(json.dumps(result))

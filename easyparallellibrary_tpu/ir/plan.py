@@ -103,6 +103,32 @@ class ParallelPlan:
             f"expert={self.expert_parallel}, "
             f"micro_batches={self.num_micro_batch})")
 
+  def format(self) -> str:
+    """Human-readable plan dump (reference: Graph.format,
+    epl/ir/graph.py:587-598 and Taskgraph pretty-printer,
+    ir/taskgraph.py:485-529)."""
+    lines = [repr(self)]
+    for tg in self.taskgraphs:
+      strat = tg.strategy
+      lines.append(
+          f"  taskgraph[{tg.index}] kind={tg.kind} "
+          f"devices/replica={tg.num_device_per_replica} "
+          f"name={strat.name!r} site={strat.identity.split('|')[0]}")
+      if tg.virtual_device is not None:
+        lines.append(f"    {tg.virtual_device!r}")
+    cluster = Env.get().cluster
+    if cluster is not None and cluster._mesh is not None:
+      mesh = cluster.mesh
+      lines.append("  mesh: " + ", ".join(
+          f"{n}={s}" for n, s in zip(mesh.axis_names, mesh.devices.shape)))
+    cfg = self.config
+    lines.append(
+        f"  features: zero={cfg.zero.level or '-'} "
+        f"gc={cfg.gradient_checkpoint.type or '-'} "
+        f"amp={cfg.amp.level or '-'} offload={cfg.offload.level or '-'} "
+        f"schedule={cfg.pipeline.strategy}")
+    return "\n".join(lines)
+
 
 def current_plan(expert_parallel: int = 1) -> ParallelPlan:
   """Lower the currently-recorded scopes into a plan."""

@@ -1,10 +1,11 @@
 from easyparallellibrary_tpu.parallel.api import (
-    TrainState, batch_sharding, create_sharded_train_state, make_train_step,
+    MutableTrainState, TrainState, batch_sharding,
+    create_sharded_train_state, make_mutable_train_step, make_train_step,
     named_sharding, parallelize, replicated_sharding, state_shardings,
 )
 
 __all__ = [
-    "TrainState", "parallelize", "named_sharding", "replicated_sharding",
+    "TrainState", "MutableTrainState", "make_mutable_train_step", "parallelize", "named_sharding", "replicated_sharding",
     "batch_sharding", "state_shardings", "create_sharded_train_state",
     "make_train_step",
 ]

@@ -41,6 +41,12 @@ class TrainState(flax_train_state.TrainState):
   features (ZeRO, AMP loss scale) can extend it."""
 
 
+class MutableTrainState(TrainState):
+  """TrainState carrying non-trainable model state (e.g. BatchNorm
+  batch_stats) updated every step."""
+  model_state: Any = None
+
+
 def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
   return NamedSharding(mesh, spec)
 
@@ -138,6 +144,28 @@ def make_train_step(loss_fn: Callable,
       grads = jax.tree_util.tree_map(
           lambda g: g * jnp.asarray(dp, g.dtype), grads)
     new_state = state.apply_gradients(grads=grads)
+    metrics = {"loss": loss}
+    if aux:
+      metrics.update(aux)
+    return new_state, metrics
+
+  return train_step
+
+
+def make_mutable_train_step(loss_fn: Callable) -> Callable:
+  """Train step for models with mutable collections (BatchNorm stats).
+
+  `loss_fn(params, model_state, batch, rng) -> (loss, (aux, new_state))`
+  — typically `model.apply({"params": p, **ms}, x, mutable=[...])`.
+  Use with :class:`MutableTrainState`.
+  """
+
+  def train_step(state, batch, rng):
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    (loss, (aux, new_model_state)), grads = grad_fn(
+        state.params, state.model_state, batch, rng)
+    new_state = state.apply_gradients(grads=grads,
+                                      model_state=new_model_state)
     metrics = {"loss": loss}
     if aux:
       metrics.update(aux)

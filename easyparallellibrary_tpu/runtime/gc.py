@@ -56,6 +56,29 @@ def policy_for(gc_type: str, policy_name: str = ""):
   return None
 
 
+def auto_checkpoint_segments(block_costs: Sequence[float],
+                             num_segments: int = 0):
+  """Memory-balanced checkpoint segmentation.
+
+  The reference's auto-GC search picks repeated-block boundaries first,
+  else a memory-balanced partition into ~sqrt(n) segments using profiled
+  bytes (epl/runtime/gc/auto_gradient_checkpoint.py:141-160).  Given
+  per-block activation costs (bytes, from profiler.compiled_memory or
+  param counts), returns the block indices that start each segment —
+  wrap each segment in `jax.checkpoint` (or pass the boundaries to a
+  block-structured model).
+  """
+  from easyparallellibrary_tpu.parallel.partitioner import partition_balance
+  n = len(block_costs)
+  if n == 0:
+    return []
+  if num_segments <= 0:
+    num_segments = max(1, int(np.sqrt(n)))
+  num_segments = min(num_segments, n)
+  ranges = partition_balance([float(c) for c in block_costs], num_segments)
+  return [s for s, _ in ranges]
+
+
 def gradients(fn: Callable, gc_type: Optional[str] = None,
               has_aux: bool = False):
   """`jax.grad` with rematerialization per the active config

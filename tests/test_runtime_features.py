@@ -190,3 +190,69 @@ def test_offload_shardings_fallback_on_cpu():
   assert jax.tree_util.tree_structure(
       moved, is_leaf=lambda x: hasattr(x, "memory_kind")
   ) is not None
+
+
+def test_auto_checkpoint_segments():
+  segs = gc_lib.auto_checkpoint_segments([1.0] * 16)
+  assert segs[0] == 0 and len(segs) == 4  # sqrt(16)
+  # Memory-balanced: a huge block gets its own segment boundary.
+  segs2 = gc_lib.auto_checkpoint_segments([1, 1, 100, 1, 1, 1], 2)
+  assert 2 in segs2 or segs2 == [0, 3]
+
+
+def test_mutable_train_step_batchnorm():
+  from easyparallellibrary_tpu.parallel import (
+      MutableTrainState, make_mutable_train_step)
+
+  class BNNet(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+      x = ops.Dense(8, parallel="none")(x)
+      x = nn.BatchNorm(use_running_average=not train)(x)
+      return ops.Dense(1, parallel="none")(x)
+
+  env = epl.init()
+  mesh = epl.current_plan().build_mesh()
+  model = BNNet()
+  x = jnp.asarray(np.random.RandomState(0).randn(16, 4), jnp.float32)
+  y = jnp.asarray(np.random.RandomState(1).randn(16, 1), jnp.float32)
+  variables = model.init(jax.random.PRNGKey(0), x)
+
+  def init_fn(rng):
+    v = model.init(rng, x)
+    return MutableTrainState.create(
+        apply_fn=model.apply, params=v["params"], tx=optax.adam(1e-2),
+        model_state={"batch_stats": v["batch_stats"]})
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+
+  def loss_fn(params, model_state, batch, rng):
+    out, new_ms = model.apply({"params": params, **model_state},
+                              batch["x"], train=True,
+                              mutable=["batch_stats"])
+    return jnp.mean((out - batch["y"]) ** 2), ({}, new_ms)
+
+  step = parallelize(make_mutable_train_step(loss_fn), mesh, shardings)
+  stats0 = jax.tree_util.tree_leaves(state.model_state)[0].copy()
+  losses = []
+  for _ in range(8):
+    state, m = step(state, {"x": x, "y": y}, jax.random.PRNGKey(2))
+    losses.append(float(m["loss"]))
+  assert losses[-1] < losses[0]
+  stats1 = jax.tree_util.tree_leaves(state.model_state)[0]
+  assert float(jnp.max(jnp.abs(stats1 - stats0))) > 0  # stats updated
+
+
+def test_plan_format():
+  epl.init(epl.Config({"zero.level": "v0"}))
+  with epl.replicate(1):
+    pass
+  with epl.split(2):
+    pass
+  plan = epl.current_plan()
+  plan.build_mesh()
+  text = plan.format()
+  assert "taskgraph[0]" in text and "kind=replicate" in text
+  assert "kind=split" in text
+  assert "mesh:" in text and "zero=v0" in text
