@@ -1,0 +1,95 @@
+"""Collective microbenchmarks over mesh axes.
+
+SURVEY §7 step 3: the communication layer ships with microbenchmarks —
+the substrate-validation role of the reference's communicator tests and
+NCCL tuning.  Measures algorithmic bandwidth of all-reduce / all-gather /
+reduce-scatter / all-to-all / ring-shift per axis.
+
+Run: `python benchmarks/bench_collectives.py [--axis data] [--mb 64]`
+(on CPU it validates the paths; numbers mean something on real chips).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.communicators import (
+    all_gather, all_reduce, all_to_all, reduce_scatter, ring_shift)
+
+shard_map = jax.shard_map
+
+
+def _time(fn, arg, iters=10):
+  scalar = jax.jit(lambda x: jnp.float32(jnp.sum(fn(x))))
+  float(jax.device_get(scalar(arg)))           # compile + warm
+  tiny = jax.jit(lambda v: v + 1)
+  float(jax.device_get(tiny(jnp.float32(0))))
+  t0 = time.perf_counter()
+  float(jax.device_get(tiny(jnp.float32(1))))
+  null = time.perf_counter() - t0
+  t0 = time.perf_counter()
+  for _ in range(iters):
+    out = scalar(arg)
+  float(jax.device_get(out))
+  return max((time.perf_counter() - t0 - null) / iters, 1e-9)
+
+
+def main():
+  p = argparse.ArgumentParser()
+  p.add_argument("--axis", default="data")
+  p.add_argument("--mb", type=int, default=16, help="payload MB per device")
+  args = p.parse_args()
+
+  env = epl.init()
+  mesh = env.cluster.build_mesh()
+  n = dict(zip(mesh.axis_names, mesh.devices.shape))[args.axis]
+  if n < 2:
+    print(f"axis {args.axis} has size {n}; nothing to measure")
+    return
+
+  elems = args.mb * 1024 * 1024 // 4
+  x = jnp.ones((n * elems,), jnp.float32)
+  bytes_per_dev = elems * 4
+
+  ops = {
+      "all_reduce": lambda v: all_reduce(v, args.axis),
+      "all_gather": lambda v: all_gather(v, args.axis),
+      "reduce_scatter": lambda v: reduce_scatter(v, args.axis),
+      "ring_shift": lambda v: ring_shift(v, args.axis),
+  }
+  print(f"axis={args.axis} size={n} payload={args.mb}MB/device "
+        f"device={jax.devices()[0].device_kind}")
+  for name, op in ops.items():
+    f = shard_map(op, mesh=mesh, in_specs=P(args.axis),
+                  out_specs=P(args.axis) if name != "all_gather" else
+                  P(args.axis))
+    dt = _time(f, x)
+    # Algorithmic bandwidth: 2(n-1)/n for all-reduce, (n-1)/n for
+    # gather/scatter, 1 for shift.
+    factor = {"all_reduce": 2 * (n - 1) / n,
+              "all_gather": (n - 1) / n,
+              "reduce_scatter": (n - 1) / n,
+              "ring_shift": 1.0}[name]
+    bw = bytes_per_dev * factor / dt / 1e9
+    print(f"  {name:15s} {dt * 1e3:8.3f} ms   {bw:8.2f} GB/s")
+
+  # all_to_all needs a 2-D view per shard.
+  x2 = jnp.ones((n, n * (elems // n)), jnp.float32)
+  f = shard_map(lambda v: all_to_all(v, args.axis, 1, 0),
+                mesh=mesh, in_specs=P(args.axis, None),
+                out_specs=P(None, args.axis))
+  dt = _time(f, x2)
+  bw = bytes_per_dev * (n - 1) / n / dt / 1e9
+  print(f"  {'all_to_all':15s} {dt * 1e3:8.3f} ms   {bw:8.2f} GB/s")
+
+
+if __name__ == "__main__":
+  main()

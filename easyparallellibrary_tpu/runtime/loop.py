@@ -75,3 +75,48 @@ def fit(step_fn: Callable,
   if profiler is not None and profiler.summary():
     log.info("training profile: %s", profiler.summary())
   return state, metrics
+
+
+def evaluate(eval_fn: Callable,
+             state,
+             data: Iterable[Any],
+             *,
+             max_batches: int = 0,
+             rng=None) -> Dict[str, float]:
+  """Average `eval_fn(state, batch, rng) -> metrics` over `data`
+  (the reference's Estimator-evaluate role, epl/parallel/hooks.py:906-984;
+  metric merging across replicas is implicit under GSPMD)."""
+  rng = rng if rng is not None else jax.random.PRNGKey(0)
+  totals: Dict[str, float] = {}
+  count = 0
+  for i, batch in enumerate(data):
+    if max_batches and i >= max_batches:
+      break
+    metrics = eval_fn(state, batch, rng)
+    for k, v in metrics.items():
+      totals[k] = totals.get(k, 0.0) + float(v)
+    count += 1
+  return {k: v / max(count, 1) for k, v in totals.items()}
+
+
+def train_and_evaluate(step_fn: Callable, eval_fn: Callable, state,
+                       train_data: Iterable[Any],
+                       eval_data: Iterable[Any], *,
+                       num_steps: int, eval_every: int,
+                       max_eval_batches: int = 0, **fit_kwargs):
+  """Interleave training with periodic evaluation (Estimator
+  train_and_evaluate parity)."""
+  log = get_logger()
+  done = int(state.step) if hasattr(state, "step") else 0
+  metrics = {}
+  while done < num_steps:
+    target = min(done + eval_every, num_steps)
+    state, metrics = fit(step_fn, state, train_data, num_steps=target,
+                         **fit_kwargs)
+    done = target
+    eval_metrics = evaluate(eval_fn, state, eval_data,
+                            max_batches=max_eval_batches)
+    log.info("eval @ step %d: %s", done, eval_metrics)
+    metrics = {**metrics, **{f"eval_{k}": v
+                             for k, v in eval_metrics.items()}}
+  return state, metrics

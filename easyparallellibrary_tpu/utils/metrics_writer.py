@@ -1,0 +1,55 @@
+"""Metric logging to durable files.
+
+The reference re-points TF summaries at merged tensors so TensorBoard
+sees global values (epl/parallel/hooks.py:593-664) and optionally reports
+to the PAI platform (epl/utils/metric.py).  Here metrics are plain
+dicts; this writer appends them as JSONL (universally parseable, and
+TensorBoard's JSONL/CSV ingestion or a notebook can plot them) with
+leader-only writes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+
+class MetricsWriter:
+  def __init__(self, path: str, flush_every: int = 1):
+    self.path = path
+    self.flush_every = max(1, flush_every)
+    self._file = None
+    self._since_flush = 0
+    if jax.process_index() == 0:
+      os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+      self._file = open(path, "a")
+
+  def write(self, step: int, metrics: Dict[str, Any]):
+    if self._file is None:
+      return
+    record = {"step": int(step), "time": time.time()}
+    for k, v in metrics.items():
+      try:
+        record[k] = float(v)
+      except (TypeError, ValueError):
+        record[k] = str(v)
+    self._file.write(json.dumps(record) + "\n")
+    self._since_flush += 1
+    if self._since_flush >= self.flush_every:
+      self._file.flush()
+      self._since_flush = 0
+
+  def close(self):
+    if self._file is not None:
+      self._file.close()
+      self._file = None
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()

@@ -69,3 +69,33 @@ def test_fit_resumes_from_checkpoint(tmp_path):
   state2, _ = fit(step2, state2, [batch], num_steps=8, checkpoint_dir=ckpt,
                   log_every=0, shardings=shardings2)
   assert int(state2.step) == 8
+
+
+def test_evaluate_and_train_and_evaluate(tmp_path):
+  from easyparallellibrary_tpu.runtime.loop import evaluate, train_and_evaluate
+  state, shardings, step, batch = _setup()
+
+  def eval_fn(state, b, rng):
+    pred = state.apply_fn({"params": state.params}, b["x"])
+    return {"mse": jnp.mean((pred - b["y"]) ** 2)}
+
+  m0 = evaluate(eval_fn, state, [batch, batch])
+  assert "mse" in m0 and np.isfinite(m0["mse"])
+
+  state, metrics = train_and_evaluate(
+      step, eval_fn, state, [batch], [batch],
+      num_steps=6, eval_every=3, log_every=0)
+  assert int(state.step) == 6
+  assert "eval_mse" in metrics
+  assert metrics["eval_mse"] < m0["mse"]
+
+
+def test_metrics_writer(tmp_path):
+  import json
+  from easyparallellibrary_tpu.utils.metrics_writer import MetricsWriter
+  path = str(tmp_path / "metrics.jsonl")
+  with MetricsWriter(path) as w:
+    w.write(1, {"loss": jnp.float32(2.5), "note": "x"})
+    w.write(2, {"loss": 1.5})
+  lines = [json.loads(l) for l in open(path)]
+  assert lines[0]["loss"] == 2.5 and lines[1]["step"] == 2
