@@ -49,6 +49,7 @@ class GPTConfig:
   remat_policy: str = "nothing"      # nothing | dots | everything
   tie_embeddings: bool = True
   z_loss: float = 0.0
+  dropout_rate: float = 0.0
   # MoE (expert parallelism): every `moe_every`-th block uses experts
   # (moe_every=1 -> every block, =2 -> blocks 1,3,5..., as in Switch).
   num_experts: int = 0
@@ -154,14 +155,16 @@ class Block(nn.Module):
   @nn.compact
   def __call__(self, x):
     cfg = self.cfg
+    drop = nn.Dropout(rate=cfg.dropout_rate,
+                      deterministic=cfg.dropout_rate == 0.0)
     y = LayerNorm(dtype=cfg.dtype, name="ln1")(x)
-    x = x + CausalSelfAttention(cfg, name="attn")(y)
+    x = x + drop(CausalSelfAttention(cfg, name="attn")(y))
     y = LayerNorm(dtype=cfg.dtype, name="ln2")(x)
     if self.use_moe:
       from easyparallellibrary_tpu.models.moe import MoEMLP
-      x = x + MoEMLP(cfg, top_k=cfg.moe_top_k, name="moe")(y)
+      x = x + drop(MoEMLP(cfg, top_k=cfg.moe_top_k, name="moe")(y))
     else:
-      x = x + MLP(cfg, name="mlp")(y)
+      x = x + drop(MLP(cfg, name="mlp")(y))
     return _constrain(x, _act_spec(cfg))
 
 
@@ -264,13 +267,15 @@ def gpt_loss(model: GPT, params, batch, rng=None):
   """
   ids = batch["ids"]
   inputs, targets = ids[:, :-1], ids[:, 1:]
+  rngs = ({"dropout": rng} if (model.cfg.dropout_rate > 0
+                               and rng is not None) else None)
   if model.cfg.num_experts > 0:
     logits, state = model.apply({"params": params}, inputs,
-                                mutable=["losses"])
+                                rngs=rngs, mutable=["losses"])
     aux_leaves = jax.tree_util.tree_leaves(state.get("losses", {}))
     aux = sum(jnp.sum(l) for l in aux_leaves) if aux_leaves else 0.0
   else:
-    logits = model.apply({"params": params}, inputs)
+    logits = model.apply({"params": params}, inputs, rngs=rngs)
     aux = 0.0
   loss = distributed_sparse_softmax_cross_entropy_with_logits(
       targets, logits.astype(jnp.float32), z_loss=model.cfg.z_loss)

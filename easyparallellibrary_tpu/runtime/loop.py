@@ -13,6 +13,7 @@ kill-and-retry, SURVEY §5.3).
 from __future__ import annotations
 
 import os
+import signal
 import time
 from typing import Any, Callable, Dict, Iterable, Optional
 
@@ -33,7 +34,8 @@ def fit(step_fn: Callable,
         checkpoint_every: int = 0,
         log_every: int = 50,
         profiler: Optional[StepProfiler] = None,
-        shardings=None):
+        shardings=None,
+        checkpoint_on_preemption: bool = True):
   """Run `num_steps` of `step_fn(state, batch, rng) -> (state, metrics)`.
 
   `data` yields batches (already global/sharded — see io.DevicePrefetcher).
@@ -53,9 +55,29 @@ def fit(step_fn: Callable,
       state = state.replace(params=params, step=last)
       start_step = last
 
+  # Preemption handling (beyond the reference's kill-and-retry, SURVEY
+  # §5.3): on SIGTERM, finish the in-flight step, checkpoint, and exit so
+  # the scheduler can requeue and `fit` resumes from the checkpoint.
+  preempted = {"flag": False}
+  prev_handler = None
+  if checkpoint_on_preemption and checkpoint_dir:
+    def _on_sigterm(signum, frame):
+      preempted["flag"] = True
+    try:
+      prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # not the main thread
+      prev_handler = None
+
   it = iter(data)
   metrics: Dict[str, Any] = {}
   for step_idx in range(start_step, num_steps):
+    if preempted["flag"]:
+      log.warning("preemption signal received: checkpointing at step %d "
+                  "and exiting", step_idx)
+      saver.save_checkpoint(checkpoint_dir, state.params, step=step_idx)
+      if prev_handler is not None:
+        signal.signal(signal.SIGTERM, prev_handler)
+      raise SystemExit(0)
     try:
       batch = next(it)
     except StopIteration:
@@ -72,6 +94,8 @@ def fit(step_fn: Callable,
         and (step_idx + 1) % checkpoint_every == 0):
       saver.save_checkpoint(checkpoint_dir, state.params,
                             step=step_idx + 1)
+  if prev_handler is not None:
+    signal.signal(signal.SIGTERM, prev_handler)
   if profiler is not None and profiler.summary():
     log.info("training profile: %s", profiler.summary())
   return state, metrics

@@ -99,3 +99,35 @@ def test_metrics_writer(tmp_path):
     w.write(2, {"loss": 1.5})
   lines = [json.loads(l) for l in open(path)]
   assert lines[0]["loss"] == 2.5 and lines[1]["step"] == 2
+
+
+def test_preemption_checkpoint(tmp_path):
+  """SIGTERM mid-training -> checkpoint written -> resume works."""
+  import signal as _signal
+  from easyparallellibrary_tpu.runtime.loop import fit as _fit
+  state, shardings, step, batch = _setup()
+  ckpt = str(tmp_path / "ck")
+
+  class SignalOnce:
+    """Iterable that raises SIGTERM in-process after 3 batches."""
+    def __init__(self):
+      self.n = 0
+    def __iter__(self):
+      return self
+    def __next__(self):
+      self.n += 1
+      if self.n == 4:
+        os.kill(os.getpid(), _signal.SIGTERM)
+      return batch
+
+  import os
+  with np.testing.assert_raises(SystemExit):
+    _fit(step, state, SignalOnce(), num_steps=100, checkpoint_dir=ckpt,
+         log_every=0, shardings=shardings)
+  saved = latest_step(ckpt)
+  assert saved is not None and 3 <= saved <= 5
+  # Resume completes the run.
+  state2, shardings2, step2, _ = _setup()
+  state2, _ = _fit(step2, state2, [batch], num_steps=saved + 2,
+                   checkpoint_dir=ckpt, log_every=0, shardings=shardings2)
+  assert int(state2.step) == saved + 2

@@ -106,3 +106,21 @@ def test_remat_matches_no_remat():
   jax.tree_util.tree_map(
       lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
       g1, g2)
+
+
+def test_dropout_active_in_training():
+  import dataclasses
+  cfg = dataclasses.replace(TINY, dropout_rate=0.5)
+  model = GPT(cfg)
+  ids = jnp.zeros((2, 8), jnp.int32)
+  params = model.init({"params": jax.random.PRNGKey(0),
+                       "dropout": jax.random.PRNGKey(1)}, ids)["params"]
+  o1 = model.apply({"params": params}, ids,
+                   rngs={"dropout": jax.random.PRNGKey(2)})
+  o2 = model.apply({"params": params}, ids,
+                   rngs={"dropout": jax.random.PRNGKey(3)})
+  assert float(jnp.max(jnp.abs(o1 - o2))) > 0  # stochastic
+  from easyparallellibrary_tpu.models.gpt import gpt_loss
+  l, _ = gpt_loss(model, params, {"ids": jnp.zeros((2, 9), jnp.int32)},
+                  jax.random.PRNGKey(4))
+  assert np.isfinite(float(l))
