@@ -25,12 +25,18 @@ def _apply(x, spec: P):
 
 
 def replica_to_split(x, dim: int = -1):
-  """Enter a tensor-parallel region: shard `dim` over the model axis."""
-  spec = [None] * x.ndim
+  """Enter a tensor-parallel region: shard `dim` over the model axis.
+
+  Other dims stay UNCONSTRAINED so batch/seq sharding flows through
+  untouched (None would pin them to replicated)."""
+  spec = [P.UNCONSTRAINED] * x.ndim
   spec[dim if dim >= 0 else x.ndim + dim] = constants.MODEL_AXIS
   return _apply(x, P(*spec))
 
 
-def split_to_replica(x):
-  """Leave a tensor-parallel region: gather to replicated layout."""
-  return _apply(x, P(*([None] * x.ndim)))
+def split_to_replica(x, dim: int = -1):
+  """Leave a tensor-parallel region: gather `dim` off the model axis
+  (other dims keep whatever sharding they had)."""
+  spec = [P.UNCONSTRAINED] * x.ndim
+  spec[dim if dim >= 0 else x.ndim + dim] = None
+  return _apply(x, P(*spec))

@@ -19,7 +19,7 @@ from easyparallellibrary_tpu import constants
 
 def distributed_argmax(logits, axis: int = -1):
   """Argmax over (possibly vocab-sharded) logits."""
-  spec = [None] * logits.ndim
+  spec = [P.UNCONSTRAINED] * logits.ndim
   spec[axis if axis >= 0 else logits.ndim + axis] = constants.MODEL_AXIS
   try:
     logits = jax.lax.with_sharding_constraint(logits, P(*spec))

@@ -117,11 +117,14 @@ class Dense(nn.Module):
     dtype = self.dtype or x.dtype
     y = jnp.matmul(x.astype(dtype), jnp.asarray(kernel, dtype))
     if mode == "column":
-      y = _constraint(y, P(*([None] * (y.ndim - 1)), constants.MODEL_AXIS))
+      # Leading dims UNCONSTRAINED: only the feature dim is pinned to the
+      # model axis (None would force batch/seq to gather here).
+      y = _constraint(y, P(*([P.UNCONSTRAINED] * (y.ndim - 1)),
+                           constants.MODEL_AXIS))
     elif mode == "row":
-      # XLA inserts the cross-shard psum for the contracted dim; the result
-      # is replicated over the model axis.
-      y = _constraint(y, P(*([None] * y.ndim)))
+      # The contraction over the model-sharded dim makes XLA insert the
+      # psum from dataflow; pin only the feature dim off the model axis.
+      y = _constraint(y, P(*([P.UNCONSTRAINED] * (y.ndim - 1)), None))
     if self.use_bias:
       bias = self.param(
           "bias", nn.with_partitioning(self.bias_init, bias_spec),
@@ -175,4 +178,5 @@ class Embedding(nn.Module):
       table = table.value
     logits = jnp.matmul(x, jnp.asarray(table).T.astype(x.dtype))
     return _constraint(
-        logits, P(*([None] * (logits.ndim - 1)), constants.MODEL_AXIS))
+        logits, P(*([P.UNCONSTRAINED] * (logits.ndim - 1)),
+                  constants.MODEL_AXIS))

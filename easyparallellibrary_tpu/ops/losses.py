@@ -23,7 +23,9 @@ from easyparallellibrary_tpu import constants
 
 
 def _vocab_sharded(logits):
-  spec = P(*([None] * (logits.ndim - 1)), constants.MODEL_AXIS)
+  # Leading dims are UNCONSTRAINED: a bare None would pin them to
+  # replicated and force the batch/seq shards to gather here.
+  spec = P(*([P.UNCONSTRAINED] * (logits.ndim - 1)), constants.MODEL_AXIS)
   try:
     return jax.lax.with_sharding_constraint(logits, spec)
   except Exception:

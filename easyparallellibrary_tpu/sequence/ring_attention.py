@@ -55,8 +55,10 @@ def _seq_axis_size() -> int:
 
 
 def _block_spec() -> P:
-  # [B, nb, s, H, D] with the block dim on the seq axis.
-  return P(constants.DATA_AXIS, constants.SEQ_AXIS, None, None, None)
+  # [B, nb, s, H, D] with the block dim on the seq axis; head/feature
+  # dims are UNCONSTRAINED so tensor-parallel head sharding survives.
+  return P(constants.DATA_AXIS, constants.SEQ_AXIS,
+           P.UNCONSTRAINED, P.UNCONSTRAINED, P.UNCONSTRAINED)
 
 
 @functools.partial(jax.checkpoint, static_argnums=(5, 6),
