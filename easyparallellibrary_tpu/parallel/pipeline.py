@@ -82,7 +82,7 @@ class Pipeline(nn.Module):
         cls,
         in_axes=0, out_axes=0,
         variable_axes={"params": 0},
-        split_rngs={"params": True},
+        split_rngs={"params": True, "dropout": True},
         metadata_params={nn.meta.PARTITION_NAME: constants.STAGE_AXIS},
     )
     return vmapped(name="stages", **self.stage_kwargs)
