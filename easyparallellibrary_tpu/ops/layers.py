@@ -55,16 +55,34 @@ def _constraint(x, spec: P):
     return x
 
 
-def _check_divisible(dim: int, what: str):
+def _model_axis_size() -> int:
   env = Env.get()
   if env.cluster is None or env.cluster._mesh is None:
-    return
-  model = env.cluster.axis_size(constants.MODEL_AXIS)
-  if model > 1 and dim % model != 0:
-    raise ValueError(
-        f"{what}={dim} is not divisible by the model-parallel axis size "
-        f"{model}; GSPMD requires even shards (the reference's "
-        f"remainder-to-shard-0 scheme is not TPU-friendly)")
+    return 1
+  return env.cluster.axis_size(constants.MODEL_AXIS)
+
+
+def _round_up(dim: int, multiple: int) -> int:
+  return ((dim + multiple - 1) // multiple) * multiple
+
+
+def _padded_init(init: Callable, logical_shape: Sequence[int]):
+  """Initialize at the logical shape, zero-pad to the padded shape.
+
+  Keeps init statistics (fan) exact for uneven tensor-parallel dims: the
+  reference gives shard 0 the remainder (epl/ops/distributed_dense.py:
+  102-109); GSPMD wants even tiles, so we pad the weight and mask/slice
+  at the edges instead (SURVEY §7 hard parts)."""
+
+  def wrapped(key, shape, dtype=jnp.float32):
+    logical = tuple(logical_shape)
+    value = init(key, logical, dtype)
+    pad = [(0, s - l) for s, l in zip(shape, logical)]
+    if any(p != (0, 0) for p in pad):
+      value = jnp.pad(value, pad)
+    return value
+
+  return wrapped
 
 
 class Dense(nn.Module):
@@ -94,17 +112,30 @@ class Dense(nn.Module):
       raise ValueError(f"Dense.parallel must be auto/none/column/row, "
                        f"got {self.parallel!r}")
     in_features = x.shape[-1]
-    kshape = (in_features, self.features)
+    model = _model_axis_size()
+    out_features = self.features
+    kshape = (in_features, out_features)
 
     if mode == "column":
-      _check_divisible(self.features, "Dense.features")
+      # Uneven feature dims are zero-padded to an even tiling; the output
+      # is sliced back to the logical width.
+      padded_out = _round_up(out_features, model)
+      kshape = (in_features, padded_out)
       kernel_init = nn.with_partitioning(
-          self.kernel_init, (None, constants.MODEL_AXIS))
+          _padded_init(self.kernel_init, (in_features, out_features)),
+          (None, constants.MODEL_AXIS))
       bias_spec: Tuple = (constants.MODEL_AXIS,)
     elif mode == "row":
-      _check_divisible(in_features, "Dense input features")
+      # Uneven contraction dims: pad the input with zeros so the padded
+      # kernel rows contribute nothing.
+      padded_in = _round_up(in_features, model)
+      if padded_in != in_features:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1)
+                    + [(0, padded_in - in_features)])
+      kshape = (padded_in, out_features)
       kernel_init = nn.with_partitioning(
-          self.kernel_init, (constants.MODEL_AXIS, None))
+          _padded_init(self.kernel_init, (in_features, out_features)),
+          (constants.MODEL_AXIS, None))
       bias_spec = (None,)
     else:
       # Box even unsharded params (all-None spec): lifted transforms like
@@ -127,9 +158,15 @@ class Dense(nn.Module):
       y = _constraint(y, P(*([P.UNCONSTRAINED] * (y.ndim - 1)), None))
     if self.use_bias:
       bias = self.param(
-          "bias", nn.with_partitioning(self.bias_init, bias_spec),
-          (self.features,), self.param_dtype)
+          "bias", nn.with_partitioning(
+              _padded_init(self.bias_init, (out_features,)), bias_spec)
+          if mode == "column" else
+          nn.with_partitioning(self.bias_init, bias_spec),
+          (kshape[1] if mode == "column" else out_features,),
+          self.param_dtype)
       y = y + jnp.asarray(bias, dtype)
+    if mode == "column" and y.shape[-1] != out_features:
+      y = y[..., :out_features]
     return y
 
 
@@ -161,22 +198,28 @@ class Embedding(nn.Module):
     tp = self.parallel == "vocab" or (
         self.parallel == "auto" and _active_split() is not None)
     if tp:
-      _check_divisible(self.num_embeddings, "Embedding.num_embeddings")
+      padded = _round_up(self.num_embeddings, _model_axis_size())
       init = nn.with_partitioning(
-          self.embedding_init, (constants.MODEL_AXIS, None))
+          _padded_init(self.embedding_init,
+                       (self.num_embeddings, self.features)),
+          (constants.MODEL_AXIS, None))
+      shape = (padded, self.features)
     else:
       init = nn.with_partitioning(self.embedding_init, (None, None))
-    table = self.param("embedding", init,
-                       (self.num_embeddings, self.features),
-                       self.param_dtype)
+      shape = (self.num_embeddings, self.features)
+    table = self.param("embedding", init, shape, self.param_dtype)
     return jnp.take(jnp.asarray(table), ids, axis=0)
 
   def attend(self, x):
-    """Tied-softmax logits: x @ table.T (logits sharded on vocab if TP)."""
+    """Tied-softmax logits: x @ table.T (logits sharded on vocab if TP;
+    padded vocab rows are sliced off)."""
     table = self.get_variable("params", "embedding")
     while hasattr(table, "value"):
       table = table.value
     logits = jnp.matmul(x, jnp.asarray(table).T.astype(x.dtype))
-    return _constraint(
+    logits = _constraint(
         logits, P(*([P.UNCONSTRAINED] * (logits.ndim - 1)),
                   constants.MODEL_AXIS))
+    if logits.shape[-1] != self.num_embeddings:
+      logits = logits[..., :self.num_embeddings]
+    return logits

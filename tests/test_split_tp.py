@@ -113,20 +113,63 @@ def test_sharded_ce_matches_optax():
   np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-6)
 
 
-def test_indivisible_features_raise():
+def test_uneven_features_pad_and_match():
+  """Uneven tensor-parallel dims (the reference's remainder case) are
+  zero-padded to even tiles and sliced back; numerics match unsharded."""
+  def run(tp):
+    epl.init()
+    if tp:
+      with epl.split():
+        pass
+    mesh = epl.current_plan().build_mesh()
+
+    class Uneven(nn.Module):
+      tp: bool
+      @nn.compact
+      def __call__(self, x):
+        if self.tp:
+          with epl.split():
+            h = nn.relu(ops.Dense(10, parallel="column")(x))   # 10 % 8 != 0
+            return ops.Dense(6, parallel="row")(h)
+        h = nn.relu(ops.Dense(10, parallel="none")(x))
+        return ops.Dense(6, parallel="none")(h)
+
+    model = Uneven(tp=tp)
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 4), jnp.float32)
+    params = jax.jit(lambda: model.init(jax.random.PRNGKey(1), x))()["params"]
+    out = jax.jit(lambda p: model.apply({"params": p}, x))(params)
+    return np.asarray(out), params
+
+  out_tp, params_tp = run(True)
+  out_base, _ = run(False)
+  np.testing.assert_allclose(out_tp, out_base, rtol=1e-5, atol=1e-6)
+  # Column kernel padded from 10 -> 16 (8-way axis), zeros in the pad.
+  k = params_tp["Dense_0"]["kernel"].value
+  assert k.shape == (4, 16)
+  np.testing.assert_allclose(np.asarray(k)[:, 10:], 0.0)
+
+
+def test_uneven_vocab_embedding_attend():
   epl.init()
   with epl.split():
     pass
   mesh = epl.current_plan().build_mesh()
 
-  class Bad(nn.Module):
+  class Tied(nn.Module):
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, ids):
       with epl.split():
-        return ops.Dense(10)(x)  # 10 % 8 != 0
+        emb = ops.Embedding(num_embeddings=70, features=16)  # 70 % 8 != 0
+        x = emb(ids)
+        return emb.attend(x)
 
-  with pytest.raises(ValueError):
-    Bad().init(jax.random.PRNGKey(0), jnp.ones((2, 4)))
+  model = Tied()
+  ids = jnp.asarray([[1, 2, 69]], jnp.int32)
+  params = jax.jit(lambda: model.init(jax.random.PRNGKey(0), ids))()["params"]
+  logits = model.apply({"params": params}, ids)
+  assert logits.shape == (1, 3, 70)  # padded rows sliced off
+  table = params["Embedding_0"]["embedding"].value
+  assert table.shape[0] == 72
 
 
 def test_vocab_sharded_embedding():
