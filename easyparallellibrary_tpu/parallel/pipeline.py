@@ -19,6 +19,10 @@ micro-batch) op sets (epl/strategies/scheduler.py).  Here the pipeline is a
     aggregation the reference builds by hand
     (epl/parallel/graph_editor.py:610-668).
 
+The tick loop runs unrolled for small micro-batch counts (XLA sees every
+tick and overlaps freely) and as a ``lax.scan`` (via ``nn.scan``) for
+large ones, bounding compile time; both share one parameter structure.
+
 Schedules (reference epl/strategies/scheduler.py:120-131) map to memory
 policies rather than control edges — see strategies/scheduler.py.
 
@@ -38,6 +42,9 @@ from jax.sharding import PartitionSpec as P
 
 from easyparallellibrary_tpu import constants
 
+# Past this many ticks, the loop compiles as lax.scan instead of unrolled.
+SCAN_THRESHOLD = 16
+
 
 def _constrain(x, spec: P):
   try:
@@ -53,6 +60,51 @@ def _state_spec(ndim: int, seq_parallel: bool = False) -> P:
   return P(constants.STAGE_AXIS, constants.DATA_AXIS, seq, *tail)
 
 
+class _TickCell(nn.Module):
+  """One pipeline tick: shift the ring, feed stage 0, apply all stages,
+  collect the last stage's emission.  Owns the stacked stage params so
+  the unrolled, scanned, and sequential paths share one structure."""
+
+  stage_module_cls: Any
+  stage_kwargs: dict
+  num_stages: int
+  remat_stage: bool = False
+  seq_parallel: bool = False
+
+  def setup(self):
+    cls = self.stage_module_cls
+    if self.remat_stage:
+      cls = nn.checkpoint(cls, prevent_cse=False)
+    vmapped = nn.vmap(
+        cls,
+        in_axes=0, out_axes=0,
+        variable_axes={"params": 0},
+        split_rngs={"params": True, "dropout": True},
+        metadata_params={nn.meta.PARTITION_NAME: constants.STAGE_AXIS},
+    )
+    self.stacked = vmapped(name="stacked", **self.stage_kwargs)
+
+  def run_stages(self, stacked_in):
+    """Apply every stage to its row (used by the sequential path)."""
+    return self.stacked(stacked_in)
+
+  def __call__(self, carry, xs):
+    state, outputs = carry
+    feed, out_idx, collect = xs
+    S = self.num_stages
+    shifted = jnp.roll(state, shift=1, axis=0).at[0].set(feed)
+    shifted = _constrain(shifted,
+                         _state_spec(state.ndim, self.seq_parallel))
+    state = self.stacked(shifted)
+    state = _constrain(state, _state_spec(state.ndim, self.seq_parallel))
+    last = state[S - 1]
+    updated = jax.lax.dynamic_update_slice(
+        outputs, last[None].astype(outputs.dtype),
+        (out_idx,) + (0,) * (outputs.ndim - 1))
+    outputs = jnp.where(collect, updated, outputs)
+    return (state, outputs), None
+
+
 class Pipeline(nn.Module):
   """Runs `stage_module` as an S-stage, M-micro-batch pipeline.
 
@@ -64,6 +116,9 @@ class Pipeline(nn.Module):
   ``sequential=True`` applies the same stacked params one stage after
   another without micro-batching — the ground-truth path used by the
   numeric-equivalence tests (and by single-device debugging).
+
+  ``use_scan``: None (auto — scan when ticks > SCAN_THRESHOLD), True, or
+  False.
   """
 
   stage_module_cls: Any            # nn.Module subclass
@@ -73,25 +128,18 @@ class Pipeline(nn.Module):
   sequential: bool = False
   remat_stage: bool = False
   seq_parallel: bool = False
-
-  def _stacked(self):
-    cls = self.stage_module_cls
-    if self.remat_stage:
-      cls = nn.checkpoint(cls, prevent_cse=False)
-    vmapped = nn.vmap(
-        cls,
-        in_axes=0, out_axes=0,
-        variable_axes={"params": 0},
-        split_rngs={"params": True, "dropout": True},
-        metadata_params={nn.meta.PARTITION_NAME: constants.STAGE_AXIS},
-    )
-    return vmapped(name="stages", **self.stage_kwargs)
+  use_scan: Optional[bool] = None
 
   @nn.compact
   def __call__(self, x):
     S = self.num_stages
     M = self.num_micro_batch
-    stacked = self._stacked()
+    cell = _TickCell(stage_module_cls=self.stage_module_cls,
+                     stage_kwargs=self.stage_kwargs,
+                     num_stages=S,
+                     remat_stage=self.remat_stage,
+                     seq_parallel=self.seq_parallel,
+                     name="stages")
 
     if self.sequential or S == 1:
       # Apply stages one after another on the full batch.  Implemented by
@@ -102,7 +150,7 @@ class Pipeline(nn.Module):
       y = x
       for s in range(S):
         stacked_in = jnp.broadcast_to(y[None], (S,) + y.shape)
-        out = stacked(stacked_in)
+        out = cell.run_stages(stacked_in)
         y = out[s]
       return y
 
@@ -117,20 +165,28 @@ class Pipeline(nn.Module):
     outputs = jnp.zeros((M,) + mb_shape, x.dtype)
 
     T = M + S - 1
-    for t in range(T):
-      # Shift the buffer one stage down the ring and feed the next
-      # micro-batch into stage 0 (ticks past M re-feed the last one; their
-      # results are never collected so they contribute nothing to grads).
-      shifted = jnp.roll(state, shift=1, axis=0)
-      feed = mbs[min(t, M - 1)]
-      shifted = shifted.at[0].set(feed)
-      shifted = _constrain(shifted,
-                           _state_spec(state.ndim, self.seq_parallel))
-      state = stacked(shifted)
-      state = _constrain(state,
-                         _state_spec(state.ndim, self.seq_parallel))
-      if t >= S - 1:
-        outputs = outputs.at[t - (S - 1)].set(state[S - 1])
+    # Ticks past M re-feed the last micro-batch; their results are never
+    # collected so they contribute nothing to grads (pipeline bubble).
+    tick_ids = jnp.arange(T)
+    feeds = mbs[jnp.minimum(tick_ids, M - 1)]
+    out_idx = jnp.maximum(tick_ids - (S - 1), 0)
+    collect = tick_ids >= (S - 1)
+
+    scan = self.use_scan if self.use_scan is not None else T > SCAN_THRESHOLD
+    if scan:
+      scanned = nn.scan(
+          lambda cell, carry, xs: cell(carry, xs),
+          variable_broadcast="params",
+          split_rngs={"params": False, "dropout": True},
+          in_axes=0, out_axes=0,
+      )
+      (state, outputs), _ = scanned(cell, (state, outputs),
+                                    (feeds, out_idx, collect))
+    else:
+      carry = (state, outputs)
+      for t in range(T):
+        carry, _ = cell(carry, (feeds[t], out_idx[t], collect[t]))
+      state, outputs = carry
 
     return outputs.reshape(x.shape)
 

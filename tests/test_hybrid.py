@@ -48,8 +48,8 @@ def test_dp_tp_pp_zero_training():
 
   # Pipeline stage params stacked + sharded over stage; TP kernels over
   # model; adam state sharded over data (ZeRO).
-  qkv = state.params["pipeline"]["stages"]["block_0"]["attn"]["qkv"][
-      "kernel"]
+  qkv = state.params["pipeline"]["stages"]["stacked"]["block_0"][
+      "attn"]["qkv"]["kernel"]
   assert qkv.names == ("stage", None, "model")
   leaf = qkv.value
   assert leaf.sharding.shard_shape(leaf.shape)[0] == 1       # stage-sharded

@@ -83,7 +83,7 @@ def test_stage_params_sharded_on_stage_axis():
 
   state, shardings = create_sharded_train_state(
       init_fn, mesh, jax.random.PRNGKey(0))
-  kernel = state.params["stages"]["Dense_0"]["kernel"].value
+  kernel = state.params["stages"]["stacked"]["Dense_0"]["kernel"].value
   assert kernel.shape[0] == 4  # stacked stage dim
   assert kernel.sharding.shard_shape(kernel.shape)[0] == 1  # 1 stage/group
 
@@ -236,3 +236,25 @@ def test_gpt_interleaved_pipeline_matches_sequential():
   l_pp, _ = jax.jit(lambda p: gpt_loss(pp, p, {"ids": ids}))(params)
   l_seq, _ = jax.jit(lambda p: gpt_loss(seq, p, {"ids": ids}))(params)
   np.testing.assert_allclose(float(l_pp), float(l_seq), rtol=1e-5)
+
+
+def test_scan_mode_matches_unrolled():
+  epl.init()
+  mesh = epl.init().cluster.build_mesh(stage=4)
+  x = jnp.asarray(np.random.RandomState(2).randn(32, 16), jnp.float32)
+  unrolled = Pipeline(stage_module_cls=ToyStage, stage_kwargs=dict(width=16),
+                      num_stages=4, num_micro_batch=8, use_scan=False)
+  scanned = Pipeline(stage_module_cls=ToyStage, stage_kwargs=dict(width=16),
+                     num_stages=4, num_micro_batch=8, use_scan=True)
+  params = unrolled.init(jax.random.PRNGKey(0), x)["params"]
+  o1 = jax.jit(lambda p: unrolled.apply({"params": p}, x))(params)
+  o2 = jax.jit(lambda p: scanned.apply({"params": p}, x))(params)
+  np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-6)
+
+  g1 = jax.jit(jax.grad(
+      lambda p: jnp.mean(unrolled.apply({"params": p}, x) ** 2)))(params)
+  g2 = jax.jit(jax.grad(
+      lambda p: jnp.mean(scanned.apply({"params": p}, x) ** 2)))(params)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+      g1, g2)
