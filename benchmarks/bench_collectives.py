@@ -69,8 +69,7 @@ def main():
         f"device={jax.devices()[0].device_kind}")
   for name, op in ops.items():
     f = shard_map(op, mesh=mesh, in_specs=P(args.axis),
-                  out_specs=P(args.axis) if name != "all_gather" else
-                  P(args.axis))
+                  out_specs=P(args.axis))
     dt = _time(f, x)
     # Algorithmic bandwidth: 2(n-1)/n for all-reduce, (n-1)/n for
     # gather/scatter, 1 for shift.

@@ -65,9 +65,11 @@ class GPTConfig:
   num_micro_batch: int = 1
   pipeline_schedule: str = "PreferBackward"
   pipeline_debug_sequential: bool = False  # ground-truth path for tests
-  # Interleaved pipelining (reference config pipeline.num_stages_per_device):
+  # Interleaved placement (reference config pipeline.num_stages_per_device):
   # blocks split into K chained pipeline passes, so each device holds K
-  # non-adjacent block chunks (the circular weight distribution).
+  # non-adjacent block chunks (the circular WEIGHT DISTRIBUTION only; the
+  # bubble fraction is unchanged — true interleaved scheduling is a
+  # deferred item, see NOTES.md).
   pipeline_interleave: int = 1
 
 
@@ -151,12 +153,14 @@ class MLP(nn.Module):
 class Block(nn.Module):
   cfg: GPTConfig
   use_moe: bool = False
+  deterministic: bool = True
 
   @nn.compact
   def __call__(self, x):
     cfg = self.cfg
     drop = nn.Dropout(rate=cfg.dropout_rate,
-                      deterministic=cfg.dropout_rate == 0.0)
+                      deterministic=self.deterministic
+                      or cfg.dropout_rate == 0.0)
     y = LayerNorm(dtype=cfg.dtype, name="ln1")(x)
     x = x + drop(CausalSelfAttention(cfg, name="attn")(y))
     y = LayerNorm(dtype=cfg.dtype, name="ln2")(x)
@@ -177,6 +181,7 @@ class StageBlocks(nn.Module):
 
   cfg: GPTConfig
   blocks_per_stage: int
+  deterministic: bool = True
 
   @nn.compact
   def __call__(self, x):
@@ -184,7 +189,8 @@ class StageBlocks(nn.Module):
     for i in range(self.blocks_per_stage):
       use_moe = cfg.num_experts > 0 and \
           (i % cfg.moe_every == cfg.moe_every - 1)
-      x = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
+      x = Block(cfg, use_moe=use_moe, deterministic=self.deterministic,
+                name=f"block_{i}")(x)
     return x
 
 
@@ -203,7 +209,7 @@ class GPT(nn.Module):
   cfg: GPTConfig
 
   @nn.compact
-  def __call__(self, ids):
+  def __call__(self, ids, deterministic: bool = True):
     cfg = self.cfg
     B, S = ids.shape
     tok = Embedding(cfg.vocab_size, cfg.d_model,
@@ -230,7 +236,8 @@ class GPT(nn.Module):
             stage_module_cls=StageBlocks,
             stage_kwargs=dict(
                 cfg=cfg,
-                blocks_per_stage=cfg.num_layers // chunks),
+                blocks_per_stage=cfg.num_layers // chunks,
+                deterministic=deterministic),
             num_stages=cfg.pipeline_stages,
             num_micro_batch=cfg.num_micro_batch,
             sequential=cfg.pipeline_debug_sequential,
@@ -246,7 +253,8 @@ class GPT(nn.Module):
       for i in range(cfg.num_layers):
         use_moe = cfg.num_experts > 0 and \
           (i % cfg.moe_every == cfg.moe_every - 1)
-        x = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x)
+        x = block_cls(cfg, use_moe=use_moe, deterministic=deterministic,
+                      name=f"block_{i}")(x)
 
     x = LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
     if cfg.tie_embeddings:
@@ -267,15 +275,17 @@ def gpt_loss(model: GPT, params, batch, rng=None):
   """
   ids = batch["ids"]
   inputs, targets = ids[:, :-1], ids[:, 1:]
-  rngs = ({"dropout": rng} if (model.cfg.dropout_rate > 0
-                               and rng is not None) else None)
+  train = model.cfg.dropout_rate > 0 and rng is not None
+  rngs = {"dropout": rng} if train else None
   if model.cfg.num_experts > 0:
     logits, state = model.apply({"params": params}, inputs,
+                                deterministic=not train,
                                 rngs=rngs, mutable=["losses"])
     aux_leaves = jax.tree_util.tree_leaves(state.get("losses", {}))
     aux = sum(jnp.sum(l) for l in aux_leaves) if aux_leaves else 0.0
   else:
-    logits = model.apply({"params": params}, inputs, rngs=rngs)
+    logits = model.apply({"params": params}, inputs,
+                         deterministic=not train, rngs=rngs)
     aux = 0.0
   loss = distributed_sparse_softmax_cross_entropy_with_logits(
       targets, logits.astype(jnp.float32), z_loss=model.cfg.z_loss)

@@ -39,20 +39,35 @@ def fit(step_fn: Callable,
   """Run `num_steps` of `step_fn(state, batch, rng) -> (state, metrics)`.
 
   `data` yields batches (already global/sharded — see io.DevicePrefetcher).
+  For more steps than one pass of `data`, pass a re-iterable (a list, or a
+  zero-arg factory returning a fresh iterator) — one-shot iterators cannot
+  be rewound.  The rng is folded with the step index each step, so
+  stochastic layers (dropout) get fresh randomness.
   Returns (state, last_metrics).
   """
   log = get_logger()
   rng = rng if rng is not None else jax.random.PRNGKey(0)
   start_step = int(state.step) if hasattr(state, "step") else 0
 
+  def _ckpt_tree(st):
+    # Full training state: resuming with fresh optimizer moments would
+    # silently change the trajectory (Adam bias-correction restarts).
+    return {"params": st.params, "opt_state": st.opt_state}
+
+  def _ckpt_shardings():
+    if shardings is None:
+      return None
+    return {"params": shardings.params, "opt_state": shardings.opt_state}
+
   if checkpoint_dir:
     last = saver.latest_step(checkpoint_dir)
     if last is not None and last > start_step:
       log.info("resuming from %s at step %d", checkpoint_dir, last)
-      params, _ = saver.restore_checkpoint(
-          checkpoint_dir, target=state.params,
-          shardings=None if shardings is None else shardings.params)
-      state = state.replace(params=params, step=last)
+      restored, _ = saver.restore_checkpoint(
+          checkpoint_dir, target=_ckpt_tree(state),
+          shardings=_ckpt_shardings())
+      state = state.replace(params=restored["params"],
+                            opt_state=restored["opt_state"], step=last)
       start_step = last
 
   # Preemption handling (beyond the reference's kill-and-retry, SURVEY
@@ -68,22 +83,30 @@ def fit(step_fn: Callable,
     except ValueError:  # not the main thread
       prev_handler = None
 
-  it = iter(data)
+  it = iter(data() if callable(data) else data)
   metrics: Dict[str, Any] = {}
   for step_idx in range(start_step, num_steps):
     if preempted["flag"]:
       log.warning("preemption signal received: checkpointing at step %d "
                   "and exiting", step_idx)
-      saver.save_checkpoint(checkpoint_dir, state.params, step=step_idx)
+      saver.save_checkpoint(checkpoint_dir, _ckpt_tree(state),
+                            step=step_idx)
       if prev_handler is not None:
         signal.signal(signal.SIGTERM, prev_handler)
       raise SystemExit(0)
     try:
       batch = next(it)
     except StopIteration:
-      it = iter(data)
-      batch = next(it)
-    state, metrics = step_fn(state, batch, rng)
+      it = iter(data() if callable(data) else data)
+      try:
+        batch = next(it)
+      except StopIteration:
+        raise RuntimeError(
+            "data iterator exhausted and could not be restarted; pass a "
+            "re-iterable (list) or a zero-arg iterator factory to fit() "
+            "for multi-epoch runs") from None
+    state, metrics = step_fn(state, batch,
+                             jax.random.fold_in(rng, step_idx))
     if profiler is not None:
       profiler.tick()
     if log_every and (step_idx + 1) % log_every == 0:
@@ -92,7 +115,7 @@ def fit(step_fn: Callable,
                f"{float(loss):.5f}" if loss is not None else "n/a")
     if (checkpoint_dir and checkpoint_every
         and (step_idx + 1) % checkpoint_every == 0):
-      saver.save_checkpoint(checkpoint_dir, state.params,
+      saver.save_checkpoint(checkpoint_dir, _ckpt_tree(state),
                             step=step_idx + 1)
   if prev_handler is not None:
     signal.signal(signal.SIGTERM, prev_handler)

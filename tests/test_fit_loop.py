@@ -131,3 +131,43 @@ def test_preemption_checkpoint(tmp_path):
   state2, _ = _fit(step2, state2, [batch], num_steps=saved + 2,
                    checkpoint_dir=ckpt, log_every=0, shardings=shardings2)
   assert int(state2.step) == saved + 2
+
+
+def test_fit_resume_restores_opt_state(tmp_path):
+  """Resume must restore Adam moments, not just params."""
+  state, shardings, step, batch = _setup()
+  ckpt = str(tmp_path / "ck")
+  state, _ = fit(step, state, [batch], num_steps=6, checkpoint_dir=ckpt,
+                 checkpoint_every=6, log_every=0, shardings=shardings)
+  mu_after_6 = np.asarray(jax.device_get(
+      jax.tree_util.tree_leaves(state.opt_state)[0]))
+
+  state2, shardings2, step2, _ = _setup()
+  # Resume: opt_state should come back non-zero (Adam mu after 6 steps).
+  from easyparallellibrary_tpu.runtime import saver as saver_lib
+  restored, _ = saver_lib.restore_checkpoint(
+      ckpt, target={"params": state2.params, "opt_state": state2.opt_state})
+  mu_restored = np.asarray(
+      jax.tree_util.tree_leaves(restored["opt_state"])[0])
+  np.testing.assert_allclose(mu_restored, mu_after_6, rtol=1e-6)
+  assert float(np.max(np.abs(mu_restored))) > 0
+
+
+def test_fit_iterator_factory_multi_epoch():
+  state, shardings, step, batch = _setup()
+  calls = {"n": 0}
+
+  def factory():
+    calls["n"] += 1
+    return iter([batch, batch])  # 2 batches per "epoch"
+
+  state, _ = fit(step, state, factory, num_steps=5, log_every=0)
+  assert int(state.step) == 5
+  assert calls["n"] >= 3  # re-created for each epoch
+
+
+def test_fit_exhausted_iterator_raises_clear_error():
+  state, shardings, step, batch = _setup()
+  one_shot = iter([batch, batch])
+  with np.testing.assert_raises(RuntimeError):
+    fit(step, state, one_shot, num_steps=5, log_every=0)

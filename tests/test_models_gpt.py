@@ -108,19 +108,28 @@ def test_remat_matches_no_remat():
       g1, g2)
 
 
-def test_dropout_active_in_training():
+def test_dropout_train_eval_switch():
   import dataclasses
   cfg = dataclasses.replace(TINY, dropout_rate=0.5)
   model = GPT(cfg)
   ids = jnp.zeros((2, 8), jnp.int32)
-  params = model.init({"params": jax.random.PRNGKey(0),
-                       "dropout": jax.random.PRNGKey(1)}, ids)["params"]
-  o1 = model.apply({"params": params}, ids,
+  params = model.init(jax.random.PRNGKey(0), ids)["params"]
+  # Training mode (deterministic=False): stochastic across rngs.
+  o1 = model.apply({"params": params}, ids, deterministic=False,
                    rngs={"dropout": jax.random.PRNGKey(2)})
-  o2 = model.apply({"params": params}, ids,
+  o2 = model.apply({"params": params}, ids, deterministic=False,
                    rngs={"dropout": jax.random.PRNGKey(3)})
-  assert float(jnp.max(jnp.abs(o1 - o2))) > 0  # stochastic
+  assert float(jnp.max(jnp.abs(o1 - o2))) > 0
+  # Eval default: deterministic, no dropout rng needed.
+  e1 = model.apply({"params": params}, ids)
+  e2 = model.apply({"params": params}, ids)
+  np.testing.assert_allclose(e1, e2)
   from easyparallellibrary_tpu.models.gpt import gpt_loss
+  # With an rng: training loss (dropout active, finite).
   l, _ = gpt_loss(model, params, {"ids": jnp.zeros((2, 9), jnp.int32)},
                   jax.random.PRNGKey(4))
   assert np.isfinite(float(l))
+  # Without an rng: eval loss runs deterministically (no missing-rng
+  # error) and differs from the dropout loss in general.
+  l_eval, _ = gpt_loss(model, params, {"ids": jnp.zeros((2, 9), jnp.int32)})
+  assert np.isfinite(float(l_eval))
