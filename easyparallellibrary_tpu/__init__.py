@@ -67,9 +67,19 @@ def add_to_collection(value, key: str):
   Env.get().add_to_collection(value, key)
 
 
+def barrier(name: str = "epl_barrier"):
+  """Synchronize all processes (reference analog: the _sync_signal
+  broadcast that prevents straggler hangs at job boundaries,
+  epl/parallel/hooks.py:915-933).  No-op in single-process runs."""
+  import jax
+  if jax.process_count() > 1:
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
+
+
 __all__ = [
     "Config", "Env", "Cluster", "GraphKeys", "ParallelPlan", "Taskgraph",
     "ParallelStrategy", "Replicate", "Split", "replicate", "split",
-    "init", "set_default_strategy", "add_to_collection", "current_plan",
-    "constants",
+    "init", "set_default_strategy", "add_to_collection", "barrier",
+    "current_plan", "constants",
 ]

@@ -151,6 +151,65 @@ class Bert(nn.Module):
     return tok.attend(x)   # MLM logits via tied embedding
 
 
+class BertForQuestionAnswering(nn.Module):
+  """SQuAD-style span prediction head (the reference's pipeline tutorial
+  fine-tunes BERT on SQuAD, docs/en/tutorials/pipe.md:46-59)."""
+
+  cfg: BertConfig
+
+  @nn.compact
+  def __call__(self, ids, type_ids=None):
+    cfg = self.cfg
+    x = BertEncoderTrunk(cfg, name="bert")(ids, type_ids)
+    span = Dense(2, parallel="none", dtype=jnp.float32,
+                 param_dtype=cfg.param_dtype, name="qa_outputs")(x)
+    start_logits, end_logits = span[..., 0], span[..., 1]
+    return start_logits, end_logits
+
+
+class BertEncoderTrunk(nn.Module):
+  """Bert without the MLM head (shared trunk for task heads)."""
+
+  cfg: BertConfig
+
+  @nn.compact
+  def __call__(self, ids, type_ids=None):
+    cfg = self.cfg
+    B, S = ids.shape
+    tok = Embedding(cfg.vocab_size, cfg.d_model,
+                    parallel="vocab" if cfg.tensor_parallel else "none",
+                    param_dtype=cfg.param_dtype, name="wte")
+    pos = self.param(
+        "wpe", nn.with_partitioning(nn.initializers.normal(0.02),
+                                    (None, None)),
+        (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+    seg = Embedding(cfg.type_vocab_size, cfg.d_model, parallel="none",
+                    param_dtype=cfg.param_dtype, name="wse")
+    if type_ids is None:
+      type_ids = jnp.zeros_like(ids)
+    x = (tok(ids).astype(cfg.dtype) + pos[None, :S].astype(cfg.dtype)
+         + seg(type_ids).astype(cfg.dtype))
+    x = LayerNorm(dtype=cfg.dtype, name="ln_emb")(x)
+    x = _constrain(x, P(constants.DATA_AXIS, None, None))
+    block_cls = EncoderBlock
+    if cfg.remat:
+      block_cls = nn.checkpoint(EncoderBlock, prevent_cse=False)
+    for i in range(cfg.num_layers):
+      x = block_cls(cfg, name=f"block_{i}")(x)
+    return LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+
+
+def bert_qa_loss(model: BertForQuestionAnswering, params, batch, rng=None):
+  """Span loss; batch = {"ids", "start_positions", "end_positions"}."""
+  start_logits, end_logits = model.apply({"params": params}, batch["ids"])
+  loss = (
+      distributed_sparse_softmax_cross_entropy_with_logits(
+          batch["start_positions"], start_logits.astype(jnp.float32))
+      + distributed_sparse_softmax_cross_entropy_with_logits(
+          batch["end_positions"], end_logits.astype(jnp.float32)))
+  return jnp.mean(loss) / 2, {}
+
+
 def bert_mlm_loss(model: Bert, params, batch, rng=None):
   """Masked-LM loss; batch = {"ids": [B,S], "labels": [B,S],
   "mask": [B,S] float (1 where a token is masked/predicted)}."""

@@ -111,3 +111,32 @@ def test_resnet_dp_training_with_split_head():
     state, m = step(state, {"x": x, "y": y}, jax.random.PRNGKey(2))
     losses.append(float(m["loss"]))
   assert losses[-1] < losses[0]
+
+
+def test_bert_qa_head_trains():
+  from easyparallellibrary_tpu.models.bert import (
+      BertForQuestionAnswering, bert_qa_loss)
+  env = epl.init()
+  mesh = epl.current_plan().build_mesh()
+  model = BertForQuestionAnswering(BERT_TINY)
+  r = np.random.RandomState(0)
+  ids = jnp.asarray(r.randint(0, 128, (8, 16)), jnp.int32)
+  batch = {"ids": ids,
+           "start_positions": jnp.asarray(r.randint(0, 16, (8,)), jnp.int32),
+           "end_positions": jnp.asarray(r.randint(0, 16, (8,)), jnp.int32)}
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(rng, ids)["params"],
+                             tx=optax.adam(1e-3))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+  step = parallelize(
+      make_train_step(lambda p, b, rr: bert_qa_loss(model, p, b, rr)),
+      mesh, shardings)
+  losses = []
+  for _ in range(8):
+    state, m = step(state, batch, jax.random.PRNGKey(1))
+    losses.append(float(m["loss"]))
+  assert losses[-1] < losses[0]

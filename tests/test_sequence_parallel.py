@@ -121,3 +121,44 @@ def test_seq_sharded_batch_runs_on_seq_mesh():
   out = jax.jit(lambda a, b, c: ring_attention(a, b, c))(qs, ks, vs)
   ref = _full_attention(q, k, v)
   np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_seq_and_tensor_parallel_compose():
+  """GPT on a seq2 x model2 x data2 mesh with ring attention + TP."""
+  from easyparallellibrary_tpu.models import GPT, GPTConfig
+  from easyparallellibrary_tpu.models.gpt import gpt_loss
+  import optax
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state, make_train_step, parallelize)
+
+  env = epl.init(epl.Config({"sequence.parallelism": "ring",
+                             "sequence.axis_size": 2}))
+  with epl.split(2):
+    pass
+  mesh = epl.current_plan().build_mesh()
+  sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+  assert (sizes["seq"], sizes["model"], sizes["data"]) == (2, 2, 2)
+
+  cfg = GPTConfig(vocab_size=64, num_layers=2, num_heads=4, d_model=32,
+                  d_ff=64, max_seq_len=16, dtype=jnp.float32,
+                  tensor_parallel=True, seq_parallel=True, attn_impl="ring")
+  model = GPT(cfg)
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (4, 17)),
+                    jnp.int32)
+  tx = optax.adam(1e-2)
+
+  def init_fn(rng):
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=model.init(rng, ids[:, :-1])["params"], tx=tx)
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+  step = parallelize(
+      make_train_step(lambda p, b, r: gpt_loss(model, p, b, r)),
+      mesh, shardings)
+  losses = []
+  for _ in range(5):
+    state, m = step(state, {"ids": ids}, jax.random.PRNGKey(1))
+    losses.append(float(m["loss"]))
+  assert np.isfinite(losses).all() and losses[-1] < losses[0]
