@@ -149,8 +149,11 @@ def batch_all_reduce(tree,
     orig_dtype = buf.dtype
     wire = buf
     if compress_dtype:
-      wire_dtype = {"bf16": jnp.bfloat16, "fp16": jnp.float16}[compress_dtype]
-      wire = (buf * compress_scale).astype(wire_dtype)
+      wire_dtypes = {"bf16": jnp.bfloat16, "fp16": jnp.float16}
+      if compress_dtype not in wire_dtypes:
+        raise ValueError(f"compress_dtype must be '', 'bf16' or 'fp16'; "
+                         f"got {compress_dtype!r}")
+      wire = (buf * compress_scale).astype(wire_dtypes[compress_dtype])
     wire = collectives.all_reduce(wire, axis_name, op=op)
     if compress_dtype:
       wire = wire.astype(orig_dtype) / compress_scale

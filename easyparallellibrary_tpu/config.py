@@ -322,6 +322,9 @@ class Config:
     if self.communication.gradients_reduce_method not in ("mean", "sum"):
       raise ValueError("communication.gradients_reduce_method must be "
                        "'mean' or 'sum'")
+    if self.communication.compress_dtype not in ("", "bf16", "fp16"):
+      raise ValueError("communication.compress_dtype must be '', 'bf16' "
+                       f"or 'fp16'; got {self.communication.compress_dtype!r}")
 
   def to_dict(self) -> Dict[str, Dict[str, Any]]:
     return {c._name: getattr(self, c._name).to_dict()
