@@ -296,6 +296,39 @@ def gpt_loss(model: GPT, params, batch, rng=None):
   return total, metrics
 
 
+def generate(model: GPT, params, prompt_ids, max_new_tokens: int,
+             temperature: float = 0.0, rng=None):
+  """Autoregressive decoding; returns [B, prompt + max_new_tokens].
+
+  Each step re-runs the full forward (causality guarantees the not-yet-
+  generated tail cannot influence the next-token logits), so no KV-cache
+  state is threaded — simple and correct; a cached decode path is a
+  deferred optimization (NOTES.md).  ``temperature=0`` is greedy.
+  """
+  B, plen = prompt_ids.shape
+  total = plen + max_new_tokens
+  if total > model.cfg.max_seq_len:
+    raise ValueError(f"prompt + new tokens ({total}) exceeds "
+                     f"max_seq_len {model.cfg.max_seq_len}")
+  ids = jnp.zeros((B, total), jnp.int32).at[:, :plen].set(prompt_ids)
+  rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+  def body(t, ids):
+    logits = model.apply({"params": params}, ids)
+    next_logits = jax.lax.dynamic_slice_in_dim(
+        logits, t - 1, 1, axis=1)[:, 0]            # [B, vocab]
+    if temperature > 0:
+      step_rng = jax.random.fold_in(rng, t)
+      nxt = jax.random.categorical(
+          step_rng, next_logits / temperature, axis=-1)
+    else:
+      nxt = jnp.argmax(next_logits, axis=-1)
+    return jax.lax.dynamic_update_slice_in_dim(
+        ids, nxt[:, None].astype(jnp.int32), t, axis=1)
+
+  return jax.lax.fori_loop(plen, total, body, ids)
+
+
 def gpt_flops_per_token(cfg: GPTConfig, seq_len: Optional[int] = None) -> float:
   """Training FLOPs/token (fwd+bwd ≈ 3x fwd): 6*N_dense + attention term."""
   S = seq_len or cfg.max_seq_len

@@ -72,3 +72,21 @@ class AutoStageGenerator:
     weights = {name: float(tree_param_count(tree))
                for name, tree in params_by_block.items()}
     return self.search(list(params_by_block), block_params=weights)
+
+  def search_from_cost_model(self, apply_fns: Dict[str, Callable],
+                             *sample_args) -> List[List[str]]:
+    """Stage search weighted by XLA-measured per-block FLOPs.
+
+    `apply_fns` maps block name → a jittable fn of `sample_args` (e.g.
+    `lambda x: block.apply(params_i, x)`).  This is the profiled-cost path
+    the reference feeds from its static profiler into the planner
+    (epl/profiler/profiler.py:36-60 → parallel/planner.py).
+    """
+    from easyparallellibrary_tpu.profiler.flops import compiled_cost
+    flops = {}
+    for name, fn in apply_fns.items():
+      cost = compiled_cost(fn, *sample_args)
+      flops[name] = float(cost.get("flops", 1.0)) or 1.0
+    gen = AutoStageGenerator(policy="balance_flops",
+                             num_stages=self.num_stages)
+    return gen.search(list(apply_fns), block_flops=flops)

@@ -99,3 +99,45 @@ def test_hybrid_matches_pure_dp():
     return out
 
   np.testing.assert_allclose(run(True), run(False), rtol=2e-3)
+
+
+def test_pp_seq_tp_compose():
+  """Pipeline x sequence x tensor parallel on one mesh (stage2 x seq2 x
+  model2, data=1): the full-axis composition compiles and trains."""
+  env = epl.init(epl.Config({"sequence.parallelism": "ring",
+                             "sequence.axis_size": 2,
+                             "pipeline.num_micro_batch": 2}))
+  cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+                  d_ff=64, max_seq_len=16, dtype=jnp.float32,
+                  tensor_parallel=True, seq_parallel=True, attn_impl="ring",
+                  pipeline_stages=2, num_micro_batch=2)
+  with epl.replicate(1, name="s0"):
+    pass
+  with epl.replicate(1, name="s1"):
+    pass
+  with epl.split(2):
+    pass
+  model = GPT(cfg)
+  mesh = epl.current_plan().build_mesh()
+  sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+  assert (sizes["stage"], sizes["seq"], sizes["model"]) == (2, 2, 2)
+
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (4, 17)),
+                    jnp.int32)
+  tx = optax.adam(1e-2)
+
+  def init_fn(rng):
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=model.init(rng, ids[:, :-1])["params"], tx=tx)
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+  step = parallelize(
+      make_train_step(lambda p, b, r: gpt_loss(model, p, b, r)),
+      mesh, shardings)
+  losses = []
+  for _ in range(4):
+    state, m = step(state, {"ids": ids}, jax.random.PRNGKey(1))
+    losses.append(float(m["loss"]))
+  assert np.isfinite(losses).all() and losses[-1] < losses[0]

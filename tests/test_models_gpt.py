@@ -133,3 +133,26 @@ def test_dropout_train_eval_switch():
   # error) and differs from the dropout loss in general.
   l_eval, _ = gpt_loss(model, params, {"ids": jnp.zeros((2, 9), jnp.int32)})
   assert np.isfinite(float(l_eval))
+
+
+def test_generate_greedy_and_sampled():
+  from easyparallellibrary_tpu.models.gpt import generate
+  model = GPT(TINY)
+  prompt = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 4)),
+                       jnp.int32)
+  params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+  out = jax.jit(lambda p, ids: generate(model, p, ids, 6))(params, prompt)
+  assert out.shape == (2, 10)
+  np.testing.assert_array_equal(np.asarray(out[:, :4]), np.asarray(prompt))
+  # Greedy is deterministic.
+  out2 = jax.jit(lambda p, ids: generate(model, p, ids, 6))(params, prompt)
+  np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+  # Sampling with different rngs differs (usually).
+  s1 = generate(model, params, prompt, 6, temperature=1.0,
+                rng=jax.random.PRNGKey(1))
+  s2 = generate(model, params, prompt, 6, temperature=1.0,
+                rng=jax.random.PRNGKey(2))
+  assert not np.array_equal(np.asarray(s1), np.asarray(s2))
+  import pytest
+  with pytest.raises(ValueError):
+    generate(model, params, jnp.zeros((1, 15), jnp.int32), 10)  # > max_seq

@@ -258,3 +258,25 @@ def test_scan_mode_matches_unrolled():
   jax.tree_util.tree_map(
       lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
       g1, g2)
+
+
+def test_auto_stage_from_cost_model():
+  from easyparallellibrary_tpu.parallel.planner import AutoStageGenerator
+  epl.init(epl.Config({"pipeline.num_stages": 2}))
+  x = jnp.ones((4, 64))
+  w_small = jnp.ones((64, 64))
+  w_big = jnp.ones((64, 512))
+  fns = {
+      "small_0": lambda v: v @ w_small,
+      "small_1": lambda v: v @ w_small,
+      "big": lambda v: (v @ w_big) @ w_big.T,
+      "small_2": lambda v: v @ w_small,
+  }
+  gen = AutoStageGenerator(num_stages=2)
+  stages = gen.search_from_cost_model(fns, x)
+  flat = [n for s in stages for n in s]
+  assert flat == list(fns)
+  # The expensive block should sit alone-ish: both stages non-empty and
+  # "big" not grouped with all three smalls.
+  big_stage = [s for s in stages if "big" in s][0]
+  assert len(big_stage) < 4
