@@ -50,11 +50,7 @@ def bert_large_config(**kw):
   return BertConfig(**base)
 
 
-def _constrain(x, spec: P):
-  try:
-    return jax.lax.with_sharding_constraint(x, spec)
-  except Exception:
-    return x
+from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
 
 
 class EncoderBlock(nn.Module):

@@ -80,11 +80,7 @@ def _act_spec(cfg: GPTConfig, ndim: int = 3) -> P:
   return P(constants.DATA_AXIS, seq)
 
 
-def _constrain(x, spec: P):
-  try:
-    return jax.lax.with_sharding_constraint(x, spec)
-  except Exception:
-    return x
+from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
 
 
 class CausalSelfAttention(nn.Module):

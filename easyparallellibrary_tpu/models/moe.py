@@ -33,11 +33,7 @@ from jax.sharding import PartitionSpec as P
 from easyparallellibrary_tpu import constants
 
 
-def _constrain(x, spec: P):
-  try:
-    return jax.lax.with_sharding_constraint(x, spec)
-  except Exception:
-    return x
+from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
 
 
 class MoEMLP(nn.Module):

@@ -17,11 +17,7 @@ from jax.sharding import PartitionSpec as P
 from easyparallellibrary_tpu import constants
 
 
-def _apply(x, spec: P):
-  try:
-    return jax.lax.with_sharding_constraint(x, spec)
-  except Exception:
-    return x
+from easyparallellibrary_tpu.utils.sharding import constrain as _apply  # noqa: E402
 
 
 def replica_to_split(x, dim: int = -1):

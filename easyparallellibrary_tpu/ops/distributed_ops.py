@@ -19,12 +19,10 @@ from easyparallellibrary_tpu import constants
 
 def distributed_argmax(logits, axis: int = -1):
   """Argmax over (possibly vocab-sharded) logits."""
+  from easyparallellibrary_tpu.utils.sharding import constrain
   spec = [P.UNCONSTRAINED] * logits.ndim
   spec[axis if axis >= 0 else logits.ndim + axis] = constants.MODEL_AXIS
-  try:
-    logits = jax.lax.with_sharding_constraint(logits, P(*spec))
-  except Exception:
-    pass
+  logits = constrain(logits, P(*spec))
   return jnp.argmax(logits, axis=axis)
 
 

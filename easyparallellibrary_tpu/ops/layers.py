@@ -47,12 +47,7 @@ def _active_split():
   return None
 
 
-def _constraint(x, spec: P):
-  """Apply a sharding constraint if a mesh is active (no-op otherwise)."""
-  try:
-    return jax.lax.with_sharding_constraint(x, spec)
-  except Exception:
-    return x
+from easyparallellibrary_tpu.utils.sharding import constrain as _constraint  # noqa: E402
 
 
 def _model_axis_size() -> int:

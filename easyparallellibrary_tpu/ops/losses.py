@@ -22,14 +22,14 @@ from jax.sharding import PartitionSpec as P
 from easyparallellibrary_tpu import constants
 
 
+from easyparallellibrary_tpu.utils.sharding import constrain as _constrain
+
+
 def _vocab_sharded(logits):
   # Leading dims are UNCONSTRAINED: a bare None would pin them to
   # replicated and force the batch/seq shards to gather here.
   spec = P(*([P.UNCONSTRAINED] * (logits.ndim - 1)), constants.MODEL_AXIS)
-  try:
-    return jax.lax.with_sharding_constraint(logits, spec)
-  except Exception:
-    return logits
+  return _constrain(logits, spec)
 
 
 def distributed_sparse_softmax_cross_entropy_with_logits(

@@ -46,11 +46,7 @@ from easyparallellibrary_tpu import constants
 SCAN_THRESHOLD = 16
 
 
-def _constrain(x, spec: P):
-  try:
-    return jax.lax.with_sharding_constraint(x, spec)
-  except Exception:
-    return x
+from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
 
 
 def _state_spec(ndim: int, seq_parallel: bool = False) -> P:

@@ -40,11 +40,7 @@ from easyparallellibrary_tpu.env import Env
 NEG_INF = -1e30
 
 
-def _constrain(x, spec: P):
-  try:
-    return jax.lax.with_sharding_constraint(x, spec)
-  except Exception:
-    return x
+from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
 
 
 def _seq_axis_size() -> int:

@@ -24,11 +24,7 @@ from easyparallellibrary_tpu import constants
 from easyparallellibrary_tpu.env import Env
 
 
-def _constrain(x, spec: P):
-  try:
-    return jax.lax.with_sharding_constraint(x, spec)
-  except Exception:
-    return x
+from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
 
 
 def _seq_axis_size() -> int:

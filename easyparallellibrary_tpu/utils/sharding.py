@@ -1,0 +1,37 @@
+"""The one true sharding-constraint helper.
+
+`jax.lax.with_sharding_constraint` with a bare `PartitionSpec` requires
+an ambient mesh context (`jax.set_mesh`); without one it raises — and a
+silent try/except would turn every activation constraint in the
+framework into a no-op (GSPMD propagation from param/input shardings
+hides this numerically, but layout control is lost).  This helper binds
+the Env's mesh into a `NamedSharding` explicitly, so constraints work in
+any jit context without global mesh state.
+
+Returns `x` unchanged only when no mesh exists yet (e.g. models used
+standalone before `epl.init`), or inside `shard_map` bodies where global
+shardings do not apply.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from easyparallellibrary_tpu.env import Env
+
+UNCONSTRAINED = P.UNCONSTRAINED
+
+
+def constrain(x, spec: P):
+  env = Env.get()
+  cluster = env.cluster
+  if cluster is None or cluster._mesh is None:
+    return x
+  try:
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(cluster.mesh, spec))
+  except (ValueError, RuntimeError):
+    # e.g. inside shard_map (per-shard values), or rank mismatch from a
+    # caller that will constrain later.
+    return x

@@ -131,7 +131,8 @@ def test_gpt_pipeline_matches_gpt_sequential():
   pp = GPT(GPTConfig(**base))
   seq = GPT(GPTConfig(**base, pipeline_debug_sequential=True))
 
-  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 17)),
+  # micro-batch size (B/M) must divide the data axis (4 here).
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (16, 17)),
                     jnp.int32)
   params = pp.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
 
@@ -229,7 +230,7 @@ def test_gpt_interleaved_pipeline_matches_sequential():
               pipeline_stages=2, num_micro_batch=2, pipeline_interleave=2)
   pp = GPT(GPTConfig(**base))
   seq = GPT(GPTConfig(**base, pipeline_debug_sequential=True))
-  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (4, 17)),
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 17)),
                     jnp.int32)
   params = pp.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
   assert "pipeline_0" in params and "pipeline_1" in params
