@@ -141,6 +141,10 @@ def batch_all_reduce(tree,
   (epl/communicators/collective_communicator.py:93-123) wrapping
   sparse/coalescing rewriters around pooled NCCL calls.
   """
+  wire_dtypes = {"bf16": jnp.bfloat16, "fp16": jnp.float16}
+  if compress_dtype and compress_dtype not in wire_dtypes:
+    raise ValueError(f"compress_dtype must be '', 'bf16' or 'fp16'; "
+                     f"got {compress_dtype!r}")
   if plan is None:
     plan = build_fusion_plan(tree, fusion_threshold_mb, max_splits)
   buffers = plan.flatten(tree)
@@ -149,10 +153,6 @@ def batch_all_reduce(tree,
     orig_dtype = buf.dtype
     wire = buf
     if compress_dtype:
-      wire_dtypes = {"bf16": jnp.bfloat16, "fp16": jnp.float16}
-      if compress_dtype not in wire_dtypes:
-        raise ValueError(f"compress_dtype must be '', 'bf16' or 'fp16'; "
-                         f"got {compress_dtype!r}")
       wire = (buf * compress_scale).astype(wire_dtypes[compress_dtype])
     wire = collectives.all_reduce(wire, axis_name, op=op)
     if compress_dtype:

@@ -302,6 +302,10 @@ def generate(model: GPT, params, prompt_ids, max_new_tokens: int,
   deferred optimization (NOTES.md).  ``temperature=0`` is greedy.
   """
   B, plen = prompt_ids.shape
+  if plen == 0:
+    raise ValueError("generate() needs a non-empty prompt (at least a BOS "
+                     "token); an empty prompt would condition the first "
+                     "token on uninitialized padding")
   total = plen + max_new_tokens
   if total > model.cfg.max_seq_len:
     raise ValueError(f"prompt + new tokens ({total}) exceeds "

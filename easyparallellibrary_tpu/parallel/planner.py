@@ -87,6 +87,8 @@ class AutoStageGenerator:
     for name, fn in apply_fns.items():
       cost = compiled_cost(fn, *sample_args)
       flops[name] = float(cost.get("flops", 1.0)) or 1.0
-    gen = AutoStageGenerator(policy="balance_flops",
-                             num_stages=self.num_stages)
-    return gen.search(list(apply_fns), block_flops=flops)
+    # This method IS the balance-by-measured-flops path, regardless of the
+    # instance policy (which governs name/param-based searches).
+    if self.num_stages <= 1:
+      return [list(apply_fns)]
+    return partition_stages(list(apply_fns), self.num_stages, flops)

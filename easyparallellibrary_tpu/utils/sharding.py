@@ -19,8 +19,11 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.utils.logging import get_logger
 
 UNCONSTRAINED = P.UNCONSTRAINED
+
+_warned_sites = set()
 
 
 def constrain(x, spec: P):
@@ -28,10 +31,20 @@ def constrain(x, spec: P):
   cluster = env.cluster
   if cluster is None or cluster._mesh is None:
     return x
+  # Caller bugs must surface, not silently no-op: rank mismatches and
+  # unknown axis names raise here (NamedSharding validates axis names).
+  if len(spec) > getattr(x, "ndim", len(spec)):
+    raise ValueError(
+        f"sharding spec {spec} has more entries than value rank {x.ndim}")
+  sharding = NamedSharding(cluster.mesh, spec)
   try:
-    return jax.lax.with_sharding_constraint(
-        x, NamedSharding(cluster.mesh, spec))
-  except (ValueError, RuntimeError):
-    # e.g. inside shard_map (per-shard values), or rank mismatch from a
-    # caller that will constrain later.
+    return jax.lax.with_sharding_constraint(x, sharding)
+  except (ValueError, RuntimeError) as e:
+    # Expected only inside shard_map bodies (per-shard values reject
+    # global shardings).  Log once per site so genuine swallowed errors
+    # are visible.
+    key = (str(spec), getattr(x, "ndim", None), type(e).__name__)
+    if key not in _warned_sites:
+      _warned_sites.add(key)
+      get_logger().debug("sharding constraint %s skipped: %s", spec, e)
     return x
