@@ -12,8 +12,9 @@ deliberate differences:
     scope plays in TF1 graph mode.
   * No uneven shards: the reference gives shard 0 the remainder
     (epl/ops/distributed_dense.py:102-109, parallel/ops.py:507-523);
-    GSPMD wants even tiling, so feature dims must divide the mesh axis —
-    validated here with a clear error instead of silent remainder logic.
+    GSPMD wants even tiling, so uneven feature dims are zero-padded to an
+    even tiling (init at the logical shape for exact fan statistics,
+    outputs sliced back) instead of remainder logic.
 
 Sharding layouts (Megatron-style, expressed as GSPMD metadata):
   * column parallel: kernel P(None, "model") → activations sharded on the
