@@ -44,7 +44,43 @@ def peak_flops_per_chip() -> float:
   return 197e12  # conservative default
 
 
+def _backend_alive(timeout_s: float = 180.0) -> bool:
+  """Probe the backend with a tiny op under a watchdog: the remote-relay
+  TPU backend can wedge so hard that even a 512x512 matmul never returns,
+  which would hang the whole benchmark run."""
+  import os
+  import threading
+  result = {"ok": False}
+
+  def probe():
+    r = jax.jit(lambda v: v + 1)(jnp.float32(1))
+    float(jax.device_get(r))
+    result["ok"] = True
+
+  t = threading.Thread(target=probe, daemon=True)
+  t.start()
+  t.join(timeout_s)
+  return result["ok"]
+
+
 def main():
+  # The image's sitecustomize latches the TPU platform before env vars are
+  # read; honor an explicit CPU request (smoke mode) through the config.
+  import os
+  if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+  if not _backend_alive():
+    print(json.dumps({
+        "metric": "gpt350m_train_mfu", "value": 0.0, "unit": "mfu",
+        "vs_baseline": 0.0,
+        "detail": {"error": "backend unresponsive (device probe timed "
+                            "out); last healthy measurement was 0.441 "
+                            "MFU — see BASELINE.md"},
+    }))
+    import os
+    os._exit(0)
+
   n_chips = len(jax.devices())
   on_tpu = jax.devices()[0].platform == "tpu"
 
