@@ -134,12 +134,19 @@ def batch_all_reduce(tree,
                      fusion_threshold_mb: int = 32,
                      max_splits: int = 60,
                      compress_dtype: str = "",
-                     compress_scale: float = 1.0):
+                     compress_scale: float = 1.0,
+                     num_communicators: int = 0):
   """Fused all-reduce of a gradient pytree inside a shard_map region.
 
   Reference: ``CollectiveCommunicator.batch_allreduce``
   (epl/communicators/collective_communicator.py:93-123) wrapping
   sparse/coalescing rewriters around pooled NCCL calls.
+
+  ``num_communicators`` bounds how many buckets may be in flight
+  concurrently (the reference's communicator pool,
+  epl/communicators/communication_pool.py:84-105): bucket i waits on
+  bucket i - num_communicators via an optimization barrier.  0 = let XLA
+  schedule freely.
   """
   wire_dtypes = {"bf16": jnp.bfloat16, "fp16": jnp.float16}
   if compress_dtype and compress_dtype not in wire_dtypes:
@@ -149,11 +156,15 @@ def batch_all_reduce(tree,
     plan = build_fusion_plan(tree, fusion_threshold_mb, max_splits)
   buffers = plan.flatten(tree)
   reduced = []
-  for buf in buffers:
+  for i, buf in enumerate(buffers):
     orig_dtype = buf.dtype
     wire = buf
+    if num_communicators > 0 and i >= num_communicators:
+      # Serialize: this bucket's input waits on the (i - n)-th result.
+      wire, _ = jax.lax.optimization_barrier(
+          (wire, reduced[i - num_communicators]))
     if compress_dtype:
-      wire = (buf * compress_scale).astype(wire_dtypes[compress_dtype])
+      wire = (wire * compress_scale).astype(wire_dtypes[compress_dtype])
     wire = collectives.all_reduce(wire, axis_name, op=op)
     if compress_dtype:
       wire = wire.astype(orig_dtype) / compress_scale

@@ -103,13 +103,24 @@ class RecordReader:
   same record order and sharding.
   """
 
-  def __init__(self, files: Sequence[str], shard_index: int = 0,
-               num_shards: int = 1, num_threads: Optional[int] = None,
+  def __init__(self, files: Sequence[str], shard_index: Optional[int] = None,
+               num_shards: Optional[int] = None,
+               num_threads: Optional[int] = None,
                prefetch_records: int = 256,
                use_native: Optional[bool] = None):
     cfg = Env.get().config
     self.files = list(files)
-    self.shard_index = shard_index
+    if num_shards is None:
+      # io.slicing: shard files across processes automatically (the
+      # reference's io_slicing pass; epl/parallel/graph_editor.py:116-215).
+      if cfg.io.slicing:
+        import jax
+        num_shards = jax.process_count()
+        if shard_index is None:
+          shard_index = jax.process_index()
+      else:
+        num_shards = 1
+    self.shard_index = shard_index or 0
     self.num_shards = max(1, num_shards)
     self.num_threads = num_threads or cfg.io.num_threads
     self.prefetch_records = prefetch_records

@@ -48,10 +48,13 @@ class DevicePrefetcher:
   device (reference analog: io.prefetch, epl/config.py:62-75)."""
 
   def __init__(self, iterator: Iterator[Any], mesh: Mesh,
-               spec: Optional[P] = None, depth: int = 2):
+               spec: Optional[P] = None, depth: Optional[int] = None):
+    from easyparallellibrary_tpu.env import Env
     self._it = iter(iterator)
     self._mesh = mesh
     self._spec = spec
+    if depth is None:
+      depth = Env.get().config.io.prefetch
     self._depth = max(1, depth)
     self._queue: collections.deque = collections.deque()
 

@@ -40,7 +40,7 @@ class BertConfig:
   remat: bool = False
   pipeline_stages: int = 1
   num_micro_batch: int = 1
-  pipeline_schedule: str = "PreferBackward"
+  pipeline_schedule: str = ""   # "" = from Config pipeline.strategy
   pipeline_debug_sequential: bool = False
 
 
@@ -125,7 +125,9 @@ class Bert(nn.Module):
       from easyparallellibrary_tpu.strategies.scheduler import get_scheduler
       if cfg.num_layers % cfg.pipeline_stages != 0:
         raise ValueError("num_layers must divide pipeline_stages")
-      sched = get_scheduler(cfg.pipeline_schedule)
+      from easyparallellibrary_tpu.env import Env
+      sched = get_scheduler(cfg.pipeline_schedule
+                            or Env.get().config.pipeline.strategy)
       x = Pipeline(
           stage_module_cls=BertStage,
           stage_kwargs=dict(

@@ -63,7 +63,7 @@ class GPTConfig:
   # Pipeline parallelism: blocks grouped into stages over the stage axis.
   pipeline_stages: int = 1
   num_micro_batch: int = 1
-  pipeline_schedule: str = "PreferBackward"
+  pipeline_schedule: str = ""   # "" = from Config pipeline.strategy
   pipeline_debug_sequential: bool = False  # ground-truth path for tests
   # Interleaved placement (reference config pipeline.num_stages_per_device):
   # blocks split into K chained pipeline passes, so each device holds K
@@ -226,7 +226,9 @@ class GPT(nn.Module):
         raise ValueError(
             f"num_layers={cfg.num_layers} must divide into "
             f"pipeline_stages*interleave={chunks} homogeneous stages")
-      sched = get_scheduler(cfg.pipeline_schedule)
+      from easyparallellibrary_tpu.env import Env
+      sched = get_scheduler(cfg.pipeline_schedule
+                            or Env.get().config.pipeline.strategy)
       for k in range(K):
         x = Pipeline(
             stage_module_cls=StageBlocks,

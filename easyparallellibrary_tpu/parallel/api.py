@@ -82,7 +82,8 @@ def state_shardings(abstract_state, mesh: Mesh):
 def create_sharded_train_state(init_fn: Callable[..., Any],
                                mesh: Mesh,
                                *init_args,
-                               zero_level: str = "",
+                               zero_level: Optional[str] = None,
+                               offload: Optional[bool] = None,
                                ) -> Tuple[Any, Any]:
   """Initialize a train state directly into its sharded layout.
 
@@ -93,13 +94,25 @@ def create_sharded_train_state(init_fn: Callable[..., Any],
   is how the reference's per-device variable placement + broadcast init
   (epl/parallel/hooks.py:330-357) maps to TPU.
 
+  `zero_level` / `offload` default to the active Config (`zero.level`,
+  `offload.level`) so the annotation-and-config workflow needs no extra
+  arguments; pass explicit values to override.
+
   Returns (state, shardings).
   """
+  cfg = Env.get().config
+  if zero_level is None:
+    zero_level = cfg.zero.level
+  if offload is None:
+    offload = bool(cfg.offload.level)
   abstract = jax.eval_shape(init_fn, *init_args)
   shardings = state_shardings(abstract, mesh)
   if zero_level:
     from easyparallellibrary_tpu.runtime import zero as zero_lib
     shardings = zero_lib.shard_opt_state(abstract, shardings, mesh, zero_level)
+  if offload:
+    from easyparallellibrary_tpu.runtime.offload import offload_to_host
+    shardings = offload_to_host(shardings)
   with jax.transfer_guard("allow"):
     state = jax.jit(init_fn, out_shardings=shardings)(*init_args)
   return state, shardings

@@ -53,7 +53,8 @@ def make_explicit_dp_train_step(loss_fn: Callable,
         fusion_threshold_mb=comm.fusion_threshold_mb,
         max_splits=comm.max_splits,
         compress_dtype=comm.compress_dtype,
-        compress_scale=comm.compress_scale)
+        compress_scale=comm.compress_scale,
+        num_communicators=comm.num_communicators)
     n = collectives.axis_size(constants.DATA_AXIS)
     if comm.gradients_reduce_method == "mean":
       grads = jax.tree_util.tree_map(

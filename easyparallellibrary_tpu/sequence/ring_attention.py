@@ -99,7 +99,19 @@ def ring_attention(q, k, v, causal: bool = True,
   GSPMD).  Returns [B, S, H, D].  Falls back to one block (= standard
   blockwise attention) when no seq axis is active."""
   B, S, H, D = q.shape
-  n = num_blocks or max(_seq_axis_size(), 1)
+  axis = max(_seq_axis_size(), 1)
+  if num_blocks is None:
+    n = axis
+    # Finer blocking than one block per device when sequence.block_size
+    # asks for it (more, smaller, blocks rotate through the same ring).
+    block_size = Env.get().config.sequence.block_size
+    if block_size and S > block_size:
+      finer = S // block_size
+      # Must divide S and be a multiple of the seq axis size.
+      if S % finer == 0 and finer % axis == 0:
+        n = max(n, finer)
+  else:
+    n = num_blocks
   if S % n != 0:
     raise ValueError(f"sequence length {S} not divisible by "
                      f"{n} ring blocks")

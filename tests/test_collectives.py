@@ -171,3 +171,16 @@ def test_fusion_cap_converges_exactly():
   tree = [jnp.zeros((mb,)) for _ in range(8)]
   plan = build_fusion_plan(tree, fusion_threshold_mb=1, max_splits=7)
   assert plan.num_buckets == 7
+
+
+def test_batch_all_reduce_communicator_pool_bound():
+  mesh = _mesh1d()
+  mb = 1024 * 256 // 4
+  tree = [jnp.ones((mb,)) for _ in range(6)]
+  spec = [P("data")] * 6
+  f = _smap(functools.partial(batch_all_reduce, axis_name="data",
+                              fusion_threshold_mb=1, num_communicators=2),
+            mesh, (spec,), spec)
+  out = f(tree)
+  for leaf in out:
+    np.testing.assert_allclose(leaf, jnp.full((mb,), 8.0))

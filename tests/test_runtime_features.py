@@ -256,3 +256,24 @@ def test_plan_format():
   assert "taskgraph[0]" in text and "kind=replicate" in text
   assert "kind=split" in text
   assert "mesh:" in text and "zero=v0" in text
+
+
+def test_config_driven_zero_and_offload_defaults():
+  """create_sharded_train_state picks up zero.level/offload.level from
+  the active Config without explicit arguments."""
+  import jax
+  from jax.sharding import PartitionSpec as P
+  env, mesh, model, loss_fn, params, batch = _setup(
+      epl.Config({"zero.level": "v0"}))
+  from easyparallellibrary_tpu.parallel import TrainState
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(rng, batch["x"])["params"],
+                             tx=optax.adam(1e-2))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))  # no zero_level arg
+  specs = [s.spec for s in jax.tree_util.tree_leaves(
+      shardings.opt_state, is_leaf=lambda x: hasattr(x, "spec"))]
+  assert any("data" in str(s) for s in specs)

@@ -162,3 +162,15 @@ def test_seq_and_tensor_parallel_compose():
     state, m = step(state, {"ids": ids}, jax.random.PRNGKey(1))
     losses.append(float(m["loss"]))
   assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_ring_block_size_config_finer_blocks():
+  env = epl.init(epl.Config({"sequence.parallelism": "ring",
+                             "sequence.axis_size": 2,
+                             "sequence.block_size": 4}))
+  epl.current_plan().build_mesh()
+  q, k, v = _qkv(S=32, seed=7)   # 32/4 = 8 blocks (multiple of axis 2)
+  out = jax.jit(lambda a, b, c: ring_attention(a, b, c, causal=True))(
+      q, k, v)
+  ref = _full_attention(q, k, v, causal=True)
+  np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
