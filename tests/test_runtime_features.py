@@ -277,3 +277,25 @@ def test_config_driven_zero_and_offload_defaults():
   specs = [s.spec for s in jax.tree_util.tree_leaves(
       shardings.opt_state, is_leaf=lambda x: hasattr(x, "spec"))]
   assert any("data" in str(s) for s in specs)
+
+
+def test_amp_policy_cast():
+  from easyparallellibrary_tpu.runtime.amp import Policy
+  p = Policy()
+  tree = {"w": jnp.ones((2, 2), jnp.float32), "i": jnp.ones((2,), jnp.int32)}
+  cast = p.cast_to_compute(tree)
+  assert cast["w"].dtype == jnp.bfloat16
+  assert cast["i"].dtype == jnp.int32  # non-float leaves untouched
+
+
+def test_profile_step_static_report():
+  from easyparallellibrary_tpu.profiler.profiler import profile_step
+
+  def step(x):
+    return (x @ x).sum()
+
+  rep = profile_step(step, jnp.ones((64, 64)), tokens_per_step=128,
+                     num_stages=4, num_micro_batch=4)
+  assert rep.get("cost_flops", 0) > 0
+  assert rep["pipeline_bubble"] == 3 / 7
+  assert rep["tokens_per_step"] == 128.0
