@@ -77,8 +77,9 @@ def main():
         "detail": {"error": "backend unresponsive (device probe timed "
                             "out); last healthy measurement was 0.441 "
                             "MFU — see BASELINE.md"},
-    }))
-    import os
+    }), flush=True)
+    # _exit skips interpreter shutdown, which would hang on the wedged
+    # daemon thread; stdout is flushed above.
     os._exit(0)
 
   n_chips = len(jax.devices())

@@ -15,8 +15,9 @@ import os
 import sys
 import time
 
-if __name__ == "__main__" and "JAX_PLATFORMS" in os.environ and \
-    os.environ.get("EPL_MATRIX_REAL") != "1":
+if os.environ.get("EPL_MATRIX_REAL") != "1" and \
+    "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
   os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                              + " --xla_force_host_platform_device_count=8"
                              ).strip()

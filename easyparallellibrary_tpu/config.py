@@ -249,8 +249,9 @@ class SequenceConfig(_Category):
       "parallelism": "",
       # Size of the seq mesh axis.
       "axis_size": 1,
-      # Block size for blockwise/ring attention.
-      "block_size": 512,
+      # Block size for blockwise/ring attention; 0 = one block per
+      # seq-axis device (finer blocking is opt-in).
+      "block_size": 0,
   }
 
 

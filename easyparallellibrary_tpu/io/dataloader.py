@@ -131,19 +131,22 @@ class RecordReader:
     self._handle = None
 
   def _shard(self) -> List[str]:
-    # Same round-robin assignment as the native side.
-    return [f for i, f in enumerate(self.files)
-            if i % self.num_shards == self.shard_index]
+    # Contiguous proportional slicing honoring io.unbalanced_io_slicing /
+    # io.drop_last_files (reference parity; io/sharding.py).
+    from easyparallellibrary_tpu.io.sharding import shard_files
+    return shard_files(self.files, self.num_shards, self.shard_index)
 
   def __iter__(self) -> Iterator[bytes]:
     if not self._native:
       yield from _python_reader(self._shard())
       return
     lib = self._lib
-    c_files = (ctypes.c_char_p * len(self.files))(
-        *[f.encode() for f in self.files])
+    # Slice in python (one policy for both paths), hand the native reader
+    # the pre-sliced list as its single shard.
+    mine = self._shard()
+    c_files = (ctypes.c_char_p * len(mine))(*[f.encode() for f in mine])
     handle = lib.epl_reader_create(
-        c_files, len(self.files), self.shard_index, self.num_shards,
+        c_files, len(mine), 0, 1,
         self.num_threads, self.prefetch_records)
     cap = 1 << 16
     buf = ctypes.create_string_buffer(cap)

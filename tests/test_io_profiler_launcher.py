@@ -56,13 +56,14 @@ def test_native_matches_python_reader(tmp_path):
 
 @pytest.mark.parametrize("use_native", [True, False])
 def test_reader_sharding(tmp_path, use_native):
+  # Contiguous proportional slicing (reference io_slicing semantics).
   files = _make_files(tmp_path, n_files=4)
   shard0 = [r.decode() for r in RecordReader(
       files, shard_index=0, num_shards=2, use_native=use_native)]
   shard1 = [r.decode() for r in RecordReader(
       files, shard_index=1, num_shards=2, use_native=use_native)]
-  assert all(r.startswith(("file0", "file2")) for r in shard0)
-  assert all(r.startswith(("file1", "file3")) for r in shard1)
+  assert all(r.startswith(("file0", "file1")) for r in shard0)
+  assert all(r.startswith(("file2", "file3")) for r in shard1)
   assert len(shard0) + len(shard1) == 20
 
 
