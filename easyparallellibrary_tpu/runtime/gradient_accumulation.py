@@ -36,21 +36,26 @@ def accumulate_gradients(grad_fn: Callable, num_micro_batch: int):
   def accumulated(params, batch, rng):
     micro = split(batch)
 
-    def body(carry, mb):
+    def body(carry, inp):
+      i, mb = inp
       (loss_sum, aux_sum, grads_sum) = carry
-      (loss, aux), grads = grad_fn(params, mb, rng)
+      # Distinct rng per micro-batch: reusing one rng would give identical
+      # dropout masks across slices, diverging from full-batch semantics.
+      mb_rng = None if rng is None else jax.random.fold_in(rng, i)
+      (loss, aux), grads = grad_fn(params, mb, mb_rng)
       grads_sum = jax.tree_util.tree_map(jnp.add, grads_sum, grads)
       aux_sum = jax.tree_util.tree_map(jnp.add, aux_sum, aux)
       return (loss_sum + loss, aux_sum, grads_sum), None
 
-    # Peek shapes with the first micro-batch to build zero carries.
+    # Zero carries from abstract shapes — every micro-batch (including the
+    # first) goes through the scan, so aux metrics cover all of them.
     first = jax.tree_util.tree_map(lambda x: x[0], micro)
-    (l0, aux0), g0 = grad_fn(params, first, rng)
-    zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux0)
-    zero_g = jax.tree_util.tree_map(jnp.zeros_like, g0)
-    rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
+    (l_s, aux_s), g_s = jax.eval_shape(grad_fn, params, first, rng)
+    zeros = lambda t: jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), t)
+    carry0 = (jnp.zeros(l_s.shape, l_s.dtype), zeros(aux_s), zeros(g_s))
     (loss_sum, aux_sum, grads_sum), _ = jax.lax.scan(
-        body, (l0, zero_aux, g0), rest)
+        body, carry0, (jnp.arange(num_micro_batch), micro))
     inv = 1.0 / num_micro_batch
     scale = lambda t: jax.tree_util.tree_map(
         lambda x: x * jnp.asarray(inv, x.dtype), t)

@@ -59,6 +59,52 @@ def test_gradient_accumulation_matches_full_batch():
       g_full, g_ga)
 
 
+def test_ga_aux_includes_every_micro_batch():
+  """The aux average must cover all micro-batches, including the first
+  (round-1 bug: first slice's aux was dropped, scaling aux by (n-1)/n)."""
+  env, mesh, model, loss_fn, params, batch = _setup()
+
+  def loss_with_aux(params, b, rng):
+    loss, _ = loss_fn(params, b, rng)
+    # Aux that depends on the data: mean of the slice's inputs.
+    return loss, {"x_mean": jnp.mean(b["x"])}
+
+  grad_fn = jax.value_and_grad(loss_with_aux, has_aux=True)
+  (_, aux_full), _ = grad_fn(params, batch, None)
+  (_, aux_ga), _ = accumulate_gradients(grad_fn, 4)(params, batch, None)
+  # Mean over 4 slice-means == full mean only if all 4 slices counted.
+  np.testing.assert_allclose(
+      float(aux_full["x_mean"]), float(aux_ga["x_mean"]), rtol=1e-6)
+
+
+def test_ga_rng_differs_per_micro_batch():
+  """Dropout masks must differ across micro-batches (rng folded per slice)."""
+  env = epl.init()
+
+  def noise_fn(params, b, rng):
+    # "Gradient" is pure rng noise: identical rngs would make the
+    # accumulated average equal each slice's noise exactly.
+    noise = jax.random.normal(rng, (4,))
+    return jnp.float32(0), {"noise": noise}
+
+  def grad_fn(params, b, rng):
+    _, aux = noise_fn(params, b, rng)
+    return (jnp.float32(0), aux), {"w": jnp.zeros(())}
+
+  batch = {"x": jnp.zeros((8, 2))}
+  rng = jax.random.PRNGKey(42)
+  (_, aux), _ = accumulate_gradients(grad_fn, 4)(params=None, batch=batch,
+                                                 rng=rng)
+  # Each micro-batch i must see fold_in(rng, i); the returned aux is the
+  # average over all four distinct noises.
+  expected = np.mean(
+      [np.asarray(jax.random.normal(jax.random.fold_in(rng, i), (4,)))
+       for i in range(4)], axis=0)
+  np.testing.assert_allclose(np.asarray(aux["noise"]), expected, rtol=1e-5)
+  single = np.asarray(jax.random.normal(jax.random.fold_in(rng, 0), (4,)))
+  assert not np.allclose(np.asarray(aux["noise"]), single)
+
+
 def test_ga_config_driven_training_matches():
   def run(cfg_dict):
     env, mesh, model, loss_fn, params, batch = _setup(epl.Config(cfg_dict))
