@@ -294,6 +294,155 @@ def gpt_loss(model: GPT, params, batch, rng=None):
   return total, metrics
 
 
+def make_gpt_1f1b_grad_fn(model: GPT):
+  """Interleaved-1F1B gradient function for a pipelined GPT.
+
+  Maps the GPT parameter tree onto the generic 1F1B engine
+  (parallel/schedule_1f1b.py): embedding = feed, stacked transformer
+  stages = stage, final-LN + LM head + CE = emit.  The embedding/head
+  live outside the stacked trunk — the heterogeneous-boundary layout the
+  reference expresses as arbitrary per-stage taskgraphs
+  (epl/parallel/graph_editor.py:423-443).
+
+  Returns `grad_fn(params, batch, rng, loss_scale=None) -> ((loss, aux),
+  grads)` with grads matching the (boxed) params structure, drop-in for a
+  train step; `loss_scale` seeds the backward for AMP (see
+  schedule_1f1b.one_f_one_b).
+  """
+  from easyparallellibrary_tpu.parallel.schedule_1f1b import (
+      one_f_one_b, split_micro_batches)
+
+  cfg = model.cfg
+  if cfg.pipeline_stages <= 1:
+    raise ValueError("1F1B needs pipeline_stages > 1")
+  if cfg.pipeline_interleave > 1:
+    raise ValueError("1F1B with pipeline_interleave > 1 (interleaved "
+                     "schedule) is not supported yet; use interleave=1")
+  S, M = cfg.pipeline_stages, cfg.num_micro_batch
+
+  emb = Embedding(cfg.vocab_size, cfg.d_model,
+                  parallel="vocab" if cfg.tensor_parallel else "none",
+                  param_dtype=cfg.param_dtype)
+  ln_f = LayerNorm(dtype=cfg.dtype)
+  head = None
+  if not cfg.tie_embeddings:
+    head = Dense(cfg.vocab_size,
+                 parallel="column" if cfg.tensor_parallel else "none",
+                 use_bias=False, dtype=cfg.dtype,
+                 param_dtype=cfg.param_dtype)
+
+  def build(train: bool):
+    stage_mod = StageBlocks(cfg, blocks_per_stage=cfg.num_layers // S,
+                            deterministic=not train)
+
+    def feed_fn(fp, mb, rng):
+      ids = mb["inputs"]
+      x = emb.apply({"params": fp["wte"]}, ids).astype(cfg.dtype)
+      x = x + fp["wpe"][None, :ids.shape[1]].astype(cfg.dtype)
+      return _constrain(x, _act_spec(cfg))
+
+    def stage_fn(p_row, x, rng):
+      rngs = {"dropout": rng} if (train and rng is not None) else None
+      if cfg.num_experts > 0:
+        y, state = stage_mod.apply({"params": p_row}, x, rngs=rngs,
+                                   mutable=["losses"])
+        leaves = jax.tree_util.tree_leaves(state.get("losses", {}))
+        aux = sum(jnp.sum(l) for l in leaves) if leaves else jnp.float32(0)
+      else:
+        y = stage_mod.apply({"params": p_row}, x, rngs=rngs)
+        aux = jnp.float32(0)
+      return y, aux
+
+    def emit_fn(ep, y, mb, rng):
+      h = ln_f.apply({"params": ep["ln_f"]}, y)
+      if cfg.tie_embeddings:
+        logits = emb.apply({"params": ep["wte"]}, h,
+                           method=Embedding.attend)
+      else:
+        logits = head.apply({"params": ep["lm_head"]}, h)
+      loss = distributed_sparse_softmax_cross_entropy_with_logits(
+          mb["targets"], logits.astype(jnp.float32), z_loss=cfg.z_loss)
+      return jnp.mean(loss), {}
+
+    return one_f_one_b(feed_fn, stage_fn, emit_fn, S, M,
+                       stage_aux_weight=(cfg.moe_aux_weight
+                                         if cfg.num_experts > 0 else 0.0),
+                       seq_parallel=cfg.seq_parallel)
+
+  def grad_fn(params, batch, rng, loss_scale=None):
+    train = cfg.dropout_rate > 0 and rng is not None
+    engine = build(train)
+    un = nn.meta.unbox(params)
+    fp = {"wte": un["wte"], "wpe": un["wpe"]}
+    sp = un["pipeline"]["stages"]["stacked"]
+    if cfg.tie_embeddings:
+      ep = {"ln_f": un["ln_f"], "wte": un["wte"]}
+    else:
+      ep = {"ln_f": un["ln_f"], "lm_head": un["lm_head"]}
+    ids = batch["ids"]
+    mbs = split_micro_batches(
+        {"inputs": ids[:, :-1], "targets": ids[:, 1:]}, M)
+    (loss, aux), (gf, gs, ge) = engine(fp, sp, ep, mbs, rng,
+                                       loss_scale=loss_scale)
+
+    g = {"wpe": gf["wpe"], "ln_f": ge["ln_f"],
+         "pipeline": {"stages": {"stacked": gs}}}
+    if cfg.tie_embeddings:
+      g["wte"] = jax.tree_util.tree_map(jnp.add, gf["wte"], ge["wte"])
+    else:
+      g["wte"] = gf["wte"]
+      g["lm_head"] = ge["lm_head"]
+    grads = jax.tree_util.tree_map(
+        lambda box, gg: box.replace_boxed(gg)
+        if isinstance(box, nn.meta.AxisMetadata) else gg,
+        params, g,
+        is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata))
+    metrics = {}
+    if cfg.num_experts > 0:
+      metrics["moe_aux_loss"] = aux.get("stage_aux_loss", jnp.float32(0))
+    return (loss, metrics), grads
+
+  return grad_fn
+
+
+def make_gpt_train_step(model: GPT, config=None):
+  """Config-driven train step for GPT, schedule-aware.
+
+  Under ``PreferBackward``/``PreferBackwardOptimizer`` with pipeline
+  stages, gradients come from the true interleaved 1F1B engine
+  (reference: epl/strategies/scheduler.py:53-116 orders backward-k before
+  forward-k+1 — here the interleave is explicit in one scan); otherwise
+  the standard autodiff path (`build_train_step` over :func:`gpt_loss`).
+  """
+  from easyparallellibrary_tpu.env import Env
+  from easyparallellibrary_tpu.runtime.trainer import build_train_step
+  from easyparallellibrary_tpu.strategies.scheduler import get_scheduler
+
+  cfg = model.cfg
+  conf = config if config is not None else Env.get().config
+  sched = None
+  use_1f1b = False
+  if cfg.pipeline_stages > 1 and not cfg.pipeline_debug_sequential \
+      and cfg.pipeline_interleave <= 1:
+    sched = get_scheduler(cfg.pipeline_schedule or conf.pipeline.strategy)
+    use_1f1b = sched.remat_stage  # PreferBackward / PreferBackwardOptimizer
+
+  if not use_1f1b:
+    return build_train_step(lambda p, b, r: gpt_loss(model, p, b, r),
+                            config=conf)
+
+  # PreferBackwardOptimizer's grouped apply (reference interleaves the
+  # optimizer with the backward, scheduler.py:86-116): default to one
+  # group per stage when the config doesn't pin a count.
+  groups = None
+  if sched.grouped_apply and conf.optimizer.num_apply_group <= 1:
+    groups = cfg.pipeline_stages
+  # build_train_step owns AMP loss scaling (the engine seeds its backward
+  # with the scale), overflow skipping, and grouped apply.
+  return build_train_step(grad_fn=make_gpt_1f1b_grad_fn(model),
+                          config=conf, num_apply_group=groups)
+
+
 def generate(model: GPT, params, prompt_ids, max_new_tokens: int,
              temperature: float = 0.0, rng=None):
   """Autoregressive decoding; returns [B, prompt + max_new_tokens].

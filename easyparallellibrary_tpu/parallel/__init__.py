@@ -3,9 +3,12 @@ from easyparallellibrary_tpu.parallel.api import (
     create_sharded_train_state, make_mutable_train_step, make_train_step,
     named_sharding, parallelize, replicated_sharding, state_shardings,
 )
+from easyparallellibrary_tpu.parallel.schedule_1f1b import (
+    one_f_one_b, split_micro_batches,
+)
 
 __all__ = [
     "TrainState", "MutableTrainState", "make_mutable_train_step", "parallelize", "named_sharding", "replicated_sharding",
     "batch_sharding", "state_shardings", "create_sharded_train_state",
-    "make_train_step",
+    "make_train_step", "one_f_one_b", "split_micro_batches",
 ]

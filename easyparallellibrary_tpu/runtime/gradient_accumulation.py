@@ -24,17 +24,10 @@ def accumulate_gradients(grad_fn: Callable, num_micro_batch: int):
   if num_micro_batch <= 1:
     return grad_fn
 
-  def split(batch):
-    def reshape(x):
-      b = x.shape[0]
-      if b % num_micro_batch != 0:
-        raise ValueError(
-            f"batch {b} not divisible by num_micro_batch {num_micro_batch}")
-      return x.reshape((num_micro_batch, b // num_micro_batch) + x.shape[1:])
-    return jax.tree_util.tree_map(reshape, batch)
-
   def accumulated(params, batch, rng):
-    micro = split(batch)
+    from easyparallellibrary_tpu.parallel.schedule_1f1b import (
+        split_micro_batches)
+    micro = split_micro_batches(batch, num_micro_batch)
 
     def body(carry, inp):
       i, mb = inp

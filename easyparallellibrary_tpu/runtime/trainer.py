@@ -43,35 +43,54 @@ class AmpTrainState(TrainState):
   loss_scale: Any = None
 
 
-def build_train_step(loss_fn: Callable,
+def build_train_step(loss_fn: Optional[Callable] = None,
                      config=None,
-                     use_loss_scale: Optional[bool] = None) -> Callable:
+                     use_loss_scale: Optional[bool] = None,
+                     grad_fn: Optional[Callable] = None,
+                     num_apply_group: Optional[int] = None) -> Callable:
   """Compose the configured runtime features around
   `loss_fn(params, batch, rng) -> (loss, aux)`.
+
+  Alternatively pass `grad_fn(params, batch, rng, loss_scale=None) ->
+  ((loss, aux), grads)` for paths that compute gradients manually (the
+  1F1B pipeline schedule); it must honor `loss_scale` by seeding its
+  backward with it and returning UNSCALED grads (inf/nan preserved for
+  the finite check).  Micro-batch accumulation is skipped for a custom
+  grad_fn (such paths own their micro-batching); loss scaling, overflow
+  skipping, and grouped apply still compose around it.
 
   Returns `step(state, batch, rng) -> (state, metrics)`, ready for
   `parallel.api.parallelize`.
   """
+  if (loss_fn is None) == (grad_fn is None):
+    raise ValueError("pass exactly one of loss_fn / grad_fn")
   cfg = config if config is not None else Env.get().config
 
   ga_steps = 1
-  if cfg.pipeline.num_micro_batch > 1 and cfg.pipeline.num_stages <= 1:
+  if grad_fn is None and cfg.pipeline.num_micro_batch > 1 \
+      and cfg.pipeline.num_stages <= 1:
     # Micro-batching without pipeline = gradient accumulation (the
     # reference applies the same rule, gradient_accumulation.py:40-50).
     ga_steps = cfg.pipeline.num_micro_batch
 
   scaled = use_loss_scale if use_loss_scale is not None else (
       cfg.amp.level and cfg.amp.loss_scale not in ("", "none", "0"))
-  num_apply_group = cfg.optimizer.num_apply_group
+  if num_apply_group is None:
+    num_apply_group = cfg.optimizer.num_apply_group
 
   def step(state, batch, rng):
-    if scaled:
-      grad_fn = amp_lib.scaled_value_and_grad(
-          loss_fn, state.loss_scale.scale, has_aux=True)
+    if grad_fn is not None:
+      (loss, aux), grads = grad_fn(
+          state.params, batch, rng,
+          loss_scale=state.loss_scale.scale if scaled else None)
     else:
-      grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-    grad_fn = accumulate_gradients(grad_fn, ga_steps)
-    (loss, aux), grads = grad_fn(state.params, batch, rng)
+      if scaled:
+        g_fn = amp_lib.scaled_value_and_grad(
+            loss_fn, state.loss_scale.scale, has_aux=True)
+      else:
+        g_fn = jax.value_and_grad(loss_fn, has_aux=True)
+      g_fn = accumulate_gradients(g_fn, ga_steps)
+      (loss, aux), grads = g_fn(state.params, batch, rng)
 
     if scaled:
       finite = amp_lib.all_finite(grads)

@@ -6,17 +6,24 @@ per-(stage, micro-batch) entrance/exit op sets
 ``PreferBackward`` is 1F1B-like (bounds live activations), and
 ``PreferBackwardOptimizer`` additionally interleaves the optimizer apply.
 
-In the SPMD pipeline (parallel/pipeline.py) the *order* of work is fixed
-by dataflow — XLA schedules it — so the policies map onto what they
-actually bought on GPUs: peak-memory behavior.
+Here the policies select between two genuinely different programs:
 
-  * PreferForward          — keep all micro-batch activations (fastest,
-                             GPipe memory profile).
-  * PreferBackward         — rematerialize each stage's forward during the
-                             backward pass, so live activations stay ~one
-                             micro-batch per stage (1F1B memory profile).
+  * PreferForward          — GPipe: autodiff through the SPMD pipeline
+                             (parallel/pipeline.py); all micro-batch
+                             activations live at the fwd/bwd boundary.
+  * PreferBackward         — TRUE interleaved 1F1B: the manual
+                             fwd/bwd-wavefront scan in
+                             parallel/schedule_1f1b.py, whose residual
+                             ring structurally bounds live stage inputs to
+                             min(M, 2S-1) per stage instead of M, with
+                             per-stage recompute (matching the reference's
+                             free-and-recompute behavior).  Dispatched by
+                             models.gpt.make_gpt_train_step.
   * PreferBackwardOptimizer— PreferBackward + grouped optimizer apply
                              (see runtime/optimizer_helper.py).
+
+``remat_stage`` is also consulted by forward-only Pipeline module uses
+(eval), where it toggles per-stage checkpointing.
 """
 
 from __future__ import annotations

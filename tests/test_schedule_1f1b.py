@@ -1,0 +1,241 @@
+"""True-1F1B schedule tests (reference analog: tests/scheduler_test.py —
+the PreferBackward policy that orders backward-k before forward-k+1,
+epl/strategies/scheduler.py:53-116).
+
+Covers: numeric equivalence of the interleaved-schedule gradients against
+plain autodiff, GPT integration, the live-activation memory bound vs the
+GPipe (PreferForward) path, and schedule dispatch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.models import GPT, GPTConfig
+from easyparallellibrary_tpu.models.gpt import (
+    gpt_loss, make_gpt_1f1b_grad_fn, make_gpt_train_step)
+from easyparallellibrary_tpu.parallel.schedule_1f1b import (
+    one_f_one_b, split_micro_batches)
+
+
+def _toy_fns(D=8):
+  def feed_fn(fp, mb, rng):
+    return jnp.tanh(mb["x"] @ fp["We"])
+
+  def stage_fn(pr, x, rng):
+    return jnp.tanh(x @ pr["W"]), jnp.float32(0)
+
+  def emit_fn(ep, y, mb, rng):
+    pred = y @ ep["Wo"]
+    return jnp.mean((pred - mb["y"]) ** 2), {"pred_mean": jnp.mean(pred)}
+
+  return feed_fn, stage_fn, emit_fn
+
+
+@pytest.mark.parametrize("S,M", [(4, 6), (1, 4), (4, 1), (4, 2), (2, 8)])
+def test_1f1b_engine_matches_autodiff(S, M):
+  """Interleaved gradients == plain reverse-mode over the same pipeline,
+  across steady-state, degenerate, and M < in-flight-window shapes."""
+  epl.init()
+  D = 8
+  r = np.random.RandomState(0)
+  feed_p = {"We": jnp.asarray(r.randn(D, D) * 0.3, jnp.float32)}
+  stage_p = {"W": jnp.asarray(r.randn(S, D, D) * 0.3, jnp.float32)}
+  emit_p = {"Wo": jnp.asarray(r.randn(D, 1) * 0.3, jnp.float32)}
+  B = M * 2
+  batch = {"x": jnp.asarray(r.randn(B, D), jnp.float32),
+           "y": jnp.asarray(r.randn(B, 1), jnp.float32)}
+  feed_fn, stage_fn, emit_fn = _toy_fns()
+  mbs = split_micro_batches(batch, M)
+
+  def ref_loss(fp, sp, ep, mbs):
+    def per_mb(mb):
+      x = feed_fn(fp, mb, None)
+      for s in range(S):
+        x, _ = stage_fn(jax.tree_util.tree_map(lambda a: a[s], sp), x, None)
+      return emit_fn(ep, x, mb, None)[0]
+    return jnp.mean(jax.vmap(per_mb)(mbs))
+
+  ref_l, ref_g = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(
+      feed_p, stage_p, emit_p, mbs)
+
+  engine = one_f_one_b(feed_fn, stage_fn, emit_fn, S, M)
+  (loss, aux), grads = jax.jit(engine)(feed_p, stage_p, emit_p, mbs, None)
+  np.testing.assert_allclose(float(ref_l), float(loss), rtol=1e-5)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+      ref_g, grads)
+
+
+def _gpt_setup(M=4, dropout=0.0, **kw):
+  env = epl.init()
+  mesh = env.cluster.build_mesh(stage=2)
+  base = dict(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+              d_ff=64, max_seq_len=16, dtype=jnp.float32,
+              pipeline_stages=2, num_micro_batch=M,
+              dropout_rate=dropout)
+  base.update(kw)
+  pp = GPT(GPTConfig(**base))
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (4 * M, 17)),
+                    jnp.int32)
+  params = pp.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+  return mesh, pp, base, ids, params
+
+
+def test_gpt_1f1b_matches_autodiff():
+  """1F1B GPT gradients == autodiff through the sequential ground truth."""
+  mesh, pp, base, ids, params = _gpt_setup()
+  seq = GPT(GPTConfig(**base, pipeline_debug_sequential=True))
+
+  grad_1f1b = make_gpt_1f1b_grad_fn(pp)
+  (l1, _), g1 = jax.jit(lambda p: grad_1f1b(p, {"ids": ids}, None))(params)
+
+  def seq_loss(p):
+    return gpt_loss(seq, p, {"ids": ids})[0]
+
+  l2, g2 = jax.jit(jax.value_and_grad(seq_loss))(params)
+  np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-5),
+      g1, g2)
+
+
+def test_gpt_1f1b_train_step_decreases_loss():
+  """End-to-end: schedule dispatch + sharded training on the stage mesh."""
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state, parallelize)
+
+  env = epl.init(epl.Config({"pipeline.strategy": "PreferBackward"}))
+  mesh = env.cluster.build_mesh(stage=2)
+  base = dict(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+              d_ff=64, max_seq_len=16, dtype=jnp.float32,
+              pipeline_stages=2, num_micro_batch=4)
+  model = GPT(GPTConfig(**base))
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (16, 17)),
+                    jnp.int32)
+
+  def init_fn(rng):
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=model.init(rng, ids[:, :-1])["params"], tx=optax.adam(1e-2))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+  step = parallelize(make_gpt_train_step(model), mesh, shardings)
+  losses = []
+  for i in range(8):
+    state, m = step(state, {"ids": ids}, jax.random.PRNGKey(i))
+    losses.append(float(m["loss"]))
+  assert losses[-1] < losses[0]
+
+
+def test_gpt_train_step_dispatch():
+  """PreferForward -> autodiff path; PreferBackward -> 1F1B engine."""
+  _, pp, base, ids, params = _gpt_setup()
+  fwd_cfg = epl.Config({"pipeline.strategy": "PreferForward"})
+  bwd_cfg = epl.Config({"pipeline.strategy": "PreferBackward"})
+  # Loss from both dispatch targets must agree (same params, same data).
+  epl.init(fwd_cfg)
+  epl.init().cluster.build_mesh(stage=2)
+  step_fwd = make_gpt_train_step(pp, config=fwd_cfg)
+  step_bwd = make_gpt_train_step(pp, config=bwd_cfg)
+  state = __import__(
+      "easyparallellibrary_tpu.parallel", fromlist=["TrainState"]
+  ).TrainState.create(apply_fn=pp.apply, params=params, tx=optax.sgd(0.0))
+  _, m_fwd = jax.jit(step_fwd)(state, {"ids": ids}, None)
+  _, m_bwd = jax.jit(step_bwd)(state, {"ids": ids}, None)
+  np.testing.assert_allclose(float(m_fwd["loss"]), float(m_bwd["loss"]),
+                             rtol=1e-5)
+
+
+def test_1f1b_bounds_live_activations_vs_gpipe():
+  """The VERDICT done-criterion: PreferBackward (1F1B) compiled temp bytes
+  < PreferForward (GPipe, no remat) at M=8, S=4 — the schedule's
+  live-activation bound, not just remat."""
+  from easyparallellibrary_tpu.parallel import TrainState
+
+  env = epl.init()
+  mesh = env.cluster.build_mesh(stage=4)
+  M = 8
+  base = dict(vocab_size=64, num_layers=4, num_heads=4, d_model=64,
+              d_ff=128, max_seq_len=32, dtype=jnp.float32,
+              pipeline_stages=4, num_micro_batch=M)
+  model = GPT(GPTConfig(**base))
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2 * M, 33)),
+                    jnp.int32)
+  params = model.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+  state = TrainState.create(apply_fn=model.apply, params=params,
+                            tx=optax.sgd(0.1))
+
+  step_fwd = make_gpt_train_step(
+      model, config=epl.Config({"pipeline.strategy": "PreferForward"}))
+  step_bwd = make_gpt_train_step(
+      model, config=epl.Config({"pipeline.strategy": "PreferBackward"}))
+
+  def temp_bytes(step):
+    lowered = jax.jit(step).lower(state, {"ids": ids}, None)
+    mem = lowered.compile().memory_analysis()
+    return mem.temp_size_in_bytes
+
+  b_fwd = temp_bytes(step_fwd)
+  b_bwd = temp_bytes(step_bwd)
+  assert b_bwd < b_fwd, (b_bwd, b_fwd)
+
+
+def test_1f1b_composes_amp_and_grouped_apply():
+  """AMP loss scaling and PreferBackwardOptimizer's grouped apply compose
+  around the 1F1B gradient path via build_train_step."""
+  from easyparallellibrary_tpu.runtime.trainer import create_train_state
+
+  amp_cfg = epl.Config({"amp.level": "O1", "amp.loss_scale": "128",
+                        "pipeline.strategy": "PreferBackwardOptimizer"})
+  env = epl.init(amp_cfg)
+  env.cluster.build_mesh(stage=2)
+  base = dict(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+              d_ff=64, max_seq_len=16, dtype=jnp.float32,
+              pipeline_stages=2, num_micro_batch=4)
+  model = GPT(GPTConfig(**base))
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (16, 17)),
+                    jnp.int32)
+  params = model.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+  state = create_train_state(model.apply, params, optax.sgd(1e-2),
+                             config=amp_cfg)
+  step = make_gpt_train_step(model, config=amp_cfg)
+  new_state, m = jax.jit(step)(state, {"ids": ids}, None)
+  assert float(m["loss_scale"]) == 128.0
+  assert float(m["grads_finite"]) == 1.0
+
+  # The scaled-seed gradients must match the unscaled path after unscaling.
+  plain_cfg = epl.Config({"pipeline.strategy": "PreferBackward"})
+  plain_state = create_train_state(model.apply, params, optax.sgd(1e-2),
+                                   config=plain_cfg)
+  plain_step = make_gpt_train_step(model, config=plain_cfg)
+  plain_new, m2 = jax.jit(plain_step)(plain_state, {"ids": ids}, None)
+  np.testing.assert_allclose(float(m["loss"]), float(m2["loss"]), rtol=1e-5)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(
+          np.asarray(a.value if hasattr(a, "value") else a),
+          np.asarray(b.value if hasattr(b, "value") else b),
+          rtol=1e-4, atol=1e-6),
+      new_state.params, plain_new.params)
+
+
+def test_1f1b_dropout_uses_distinct_rngs():
+  """With dropout, two different seeds give different losses but the same
+  seed reproduces — and the recompute inside 1F1B is self-consistent
+  (finite grads, loss close to the deterministic value)."""
+  mesh, pp, base, ids, params = _gpt_setup(dropout=0.2)
+  grad_fn = make_gpt_1f1b_grad_fn(pp)
+  f = jax.jit(lambda p, r: grad_fn(p, {"ids": ids}, r))
+  (l_a, _), g_a = f(params, jax.random.PRNGKey(1))
+  (l_b, _), _ = f(params, jax.random.PRNGKey(2))
+  (l_a2, _), g_a2 = f(params, jax.random.PRNGKey(1))
+  assert float(l_a) != float(l_b)
+  np.testing.assert_allclose(float(l_a), float(l_a2), rtol=1e-6)
+  finite = jax.tree_util.tree_map(
+      lambda g: bool(jnp.all(jnp.isfinite(g.value
+                                          if hasattr(g, "value") else g))),
+      g_a)
+  assert all(jax.tree_util.tree_leaves(finite))
