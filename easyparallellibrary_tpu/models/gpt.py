@@ -171,8 +171,15 @@ class Block(nn.Module):
 class StageBlocks(nn.Module):
   """One pipeline stage = a contiguous chunk of transformer blocks.
 
-  Stages must be homogeneous so they can be stacked and vmapped over the
-  stage axis; with MoE, the expert pattern repeats per stage.
+  Stage *structure* must be homogeneous so stages can be stacked and
+  vmapped over the stage axis; with MoE, the expert pattern repeats per
+  stage.  Heterogeneous (uneven) models pass ``n_active`` — a per-stage
+  block count (traced scalar under the stage vmap): blocks at index
+  ``i >= n_active`` are computed but masked to identity, so a stage can
+  own fewer blocks than the allocated maximum.  This is the TPU answer to
+  the reference's arbitrary per-stage taskgraphs
+  (epl/parallel/graph_editor.py:423-443): SPMD needs one program for all
+  stages, so heterogeneity is data (the mask), not structure.
   """
 
   cfg: GPTConfig
@@ -180,14 +187,34 @@ class StageBlocks(nn.Module):
   deterministic: bool = True
 
   @nn.compact
-  def __call__(self, x):
+  def __call__(self, x, n_active=None):
     cfg = self.cfg
     for i in range(self.blocks_per_stage):
       use_moe = cfg.num_experts > 0 and \
           (i % cfg.moe_every == cfg.moe_every - 1)
-      x = Block(cfg, use_moe=use_moe, deterministic=self.deterministic,
+      y = Block(cfg, use_moe=use_moe, deterministic=self.deterministic,
                 name=f"block_{i}")(x)
+      if n_active is None:
+        x = y
+      else:
+        x = jnp.where(i < n_active, y, x)
     return x
+
+
+def stage_layout(num_layers: int, num_chunks: int):
+  """Distribute blocks over pipeline chunks.
+
+  Returns ``(blocks_per_chunk, n_active)``: even models get
+  ``(L/chunks, None)``; uneven models allocate ``ceil(L/chunks)`` block
+  slots per chunk with ``n_active[c]`` real blocks in chunk ``c`` (the
+  first ``L % chunks`` chunks carry the extra block) — masked-identity
+  slots make the stacked trunk homogeneous (see StageBlocks).
+  """
+  if num_layers % num_chunks == 0:
+    return num_layers // num_chunks, None
+  base, rem = divmod(num_layers, num_chunks)
+  counts = tuple(base + 1 if c < rem else base for c in range(num_chunks))
+  return base + 1, counts
 
 
 def _remat_policy(name: str):
@@ -222,25 +249,35 @@ class GPT(nn.Module):
       from easyparallellibrary_tpu.strategies.scheduler import get_scheduler
       K = max(1, cfg.pipeline_interleave)
       chunks = cfg.pipeline_stages * K
-      if cfg.num_layers % chunks != 0:
+      blocks_per_chunk, n_active = stage_layout(cfg.num_layers, chunks)
+      if n_active is not None and cfg.num_experts > 0:
         raise ValueError(
-            f"num_layers={cfg.num_layers} must divide into "
-            f"pipeline_stages*interleave={chunks} homogeneous stages")
+            f"num_layers={cfg.num_layers} must divide evenly into "
+            f"{chunks} stages when MoE is enabled (sown aux losses "
+            f"cannot be masked per stage)")
       from easyparallellibrary_tpu.env import Env
       sched = get_scheduler(cfg.pipeline_schedule
                             or Env.get().config.pipeline.strategy)
       for k in range(K):
+        extra = None
+        if n_active is not None:
+          # Pass k owns the contiguous chunks k*S .. k*S+S-1, so stage s
+          # holds chunk k*S+s in pass k — i.e. every S-th chunk across
+          # the K passes (the circular weight distribution).
+          extra = (tuple(n_active[k * cfg.pipeline_stages:
+                                  (k + 1) * cfg.pipeline_stages]),)
         x = Pipeline(
             stage_module_cls=StageBlocks,
             stage_kwargs=dict(
                 cfg=cfg,
-                blocks_per_stage=cfg.num_layers // chunks,
+                blocks_per_stage=blocks_per_chunk,
                 deterministic=deterministic),
             num_stages=cfg.pipeline_stages,
             num_micro_batch=cfg.num_micro_batch,
             sequential=cfg.pipeline_debug_sequential,
             remat_stage=sched.remat_stage or cfg.remat,
             seq_parallel=cfg.seq_parallel,
+            stage_extra=extra,
             name="pipeline" if K == 1 else f"pipeline_{k}")(x)
     else:
       block_cls = Block
@@ -319,6 +356,13 @@ def make_gpt_1f1b_grad_fn(model: GPT):
     raise ValueError("1F1B with pipeline_interleave > 1 (interleaved "
                      "schedule) is not supported yet; use interleave=1")
   S, M = cfg.pipeline_stages, cfg.num_micro_batch
+  if cfg.num_experts > 0 and cfg.num_layers % S != 0:
+    # Same guard as GPT.__call__: masked identity slots would still sow
+    # MoE aux losses (matters when params bypass GPT.init, e.g. restored
+    # checkpoints).
+    raise ValueError(
+        f"num_layers={cfg.num_layers} must divide evenly into {S} stages "
+        f"when MoE is enabled (sown aux losses cannot be masked per stage)")
 
   emb = Embedding(cfg.vocab_size, cfg.d_model,
                   parallel="vocab" if cfg.tensor_parallel else "none",
@@ -331,8 +375,10 @@ def make_gpt_1f1b_grad_fn(model: GPT):
                  use_bias=False, dtype=cfg.dtype,
                  param_dtype=cfg.param_dtype)
 
+  blocks_per_stage, n_active = stage_layout(cfg.num_layers, S)
+
   def build(train: bool):
-    stage_mod = StageBlocks(cfg, blocks_per_stage=cfg.num_layers // S,
+    stage_mod = StageBlocks(cfg, blocks_per_stage=blocks_per_stage,
                             deterministic=not train)
 
     def feed_fn(fp, mb, rng):
@@ -341,15 +387,15 @@ def make_gpt_1f1b_grad_fn(model: GPT):
       x = x + fp["wpe"][None, :ids.shape[1]].astype(cfg.dtype)
       return _constrain(x, _act_spec(cfg))
 
-    def stage_fn(p_row, x, rng):
+    def stage_fn(p_row, x, rng, *extra):
       rngs = {"dropout": rng} if (train and rng is not None) else None
       if cfg.num_experts > 0:
-        y, state = stage_mod.apply({"params": p_row}, x, rngs=rngs,
+        y, state = stage_mod.apply({"params": p_row}, x, *extra, rngs=rngs,
                                    mutable=["losses"])
         leaves = jax.tree_util.tree_leaves(state.get("losses", {}))
         aux = sum(jnp.sum(l) for l in leaves) if leaves else jnp.float32(0)
       else:
-        y = stage_mod.apply({"params": p_row}, x, rngs=rngs)
+        y = stage_mod.apply({"params": p_row}, x, *extra, rngs=rngs)
         aux = jnp.float32(0)
       return y, aux
 
@@ -367,7 +413,9 @@ def make_gpt_1f1b_grad_fn(model: GPT):
     return one_f_one_b(feed_fn, stage_fn, emit_fn, S, M,
                        stage_aux_weight=(cfg.moe_aux_weight
                                          if cfg.num_experts > 0 else 0.0),
-                       seq_parallel=cfg.seq_parallel)
+                       seq_parallel=cfg.seq_parallel,
+                       stage_extra=(None if n_active is None
+                                    else (jnp.asarray(n_active),)))
 
   def grad_fn(params, batch, rng, loss_scale=None):
     train = cfg.dropout_rate > 0 and rng is not None
@@ -422,10 +470,17 @@ def make_gpt_train_step(model: GPT, config=None):
   conf = config if config is not None else Env.get().config
   sched = None
   use_1f1b = False
-  if cfg.pipeline_stages > 1 and not cfg.pipeline_debug_sequential \
-      and cfg.pipeline_interleave <= 1:
+  if cfg.pipeline_stages > 1 and not cfg.pipeline_debug_sequential:
     sched = get_scheduler(cfg.pipeline_schedule or conf.pipeline.strategy)
     use_1f1b = sched.remat_stage  # PreferBackward / PreferBackwardOptimizer
+    if use_1f1b and cfg.pipeline_interleave > 1:
+      from easyparallellibrary_tpu.utils.logging import get_logger
+      get_logger().warning(
+          "pipeline.strategy=%s requests 1F1B but pipeline_interleave=%d "
+          "is not supported by the interleaved engine yet; falling back "
+          "to the GPipe autodiff path (M live activations per stage).",
+          sched.name, cfg.pipeline_interleave)
+      use_1f1b = False
 
   if not use_1f1b:
     return build_train_step(lambda p, b, r: gpt_loss(model, p, b, r),

@@ -59,13 +59,18 @@ def _state_spec(ndim: int, seq_parallel: bool = False) -> P:
 class _TickCell(nn.Module):
   """One pipeline tick: shift the ring, feed stage 0, apply all stages,
   collect the last stage's emission.  Owns the stacked stage params so
-  the unrolled, scanned, and sequential paths share one structure."""
+  the unrolled, scanned, and sequential paths share one structure.
+
+  ``stage_extra``: optional tuple of arrays with a leading [num_stages]
+  dim, vmapped alongside the activations into each stage (e.g. a
+  per-stage active-block count for heterogeneous models)."""
 
   stage_module_cls: Any
   stage_kwargs: dict
   num_stages: int
   remat_stage: bool = False
   seq_parallel: bool = False
+  stage_extra: Optional[tuple] = None
 
   def setup(self):
     cls = self.stage_module_cls
@@ -80,9 +85,14 @@ class _TickCell(nn.Module):
     )
     self.stacked = vmapped(name="stacked", **self.stage_kwargs)
 
+  def _extra(self):
+    if self.stage_extra is None:
+      return ()
+    return tuple(jnp.asarray(e) for e in self.stage_extra)
+
   def run_stages(self, stacked_in):
     """Apply every stage to its row (used by the sequential path)."""
-    return self.stacked(stacked_in)
+    return self.stacked(stacked_in, *self._extra())
 
   def __call__(self, carry, xs):
     state, outputs = carry
@@ -91,7 +101,7 @@ class _TickCell(nn.Module):
     shifted = jnp.roll(state, shift=1, axis=0).at[0].set(feed)
     shifted = _constrain(shifted,
                          _state_spec(state.ndim, self.seq_parallel))
-    state = self.stacked(shifted)
+    state = self.stacked(shifted, *self._extra())
     state = _constrain(state, _state_spec(state.ndim, self.seq_parallel))
     last = state[S - 1]
     updated = jax.lax.dynamic_update_slice(
@@ -125,6 +135,7 @@ class Pipeline(nn.Module):
   remat_stage: bool = False
   seq_parallel: bool = False
   use_scan: Optional[bool] = None
+  stage_extra: Optional[tuple] = None   # per-stage arrays, leading [S] dim
 
   @nn.compact
   def __call__(self, x):
@@ -135,6 +146,7 @@ class Pipeline(nn.Module):
                      num_stages=S,
                      remat_stage=self.remat_stage,
                      seq_parallel=self.seq_parallel,
+                     stage_extra=self.stage_extra,
                      name="stages")
 
     if self.sequential or S == 1:

@@ -66,11 +66,10 @@ def _mask_leading(tree, valid):
   return jax.tree_util.tree_map(mask, tree)
 
 
-def _act_spec(ndim: int, seq_parallel: bool = False) -> P:
-  """[stage, batch, (seq), ...] wavefront buffer sharding."""
-  seq = constants.SEQ_AXIS if seq_parallel else None
-  return P(constants.STAGE_AXIS, constants.DATA_AXIS, seq,
-           *([None] * (ndim - 3)))
+# [stage, batch, (seq), ...] wavefront sharding — shared with the GPipe
+# pipeline so both schedules keep identical layouts.
+from easyparallellibrary_tpu.parallel.pipeline import (  # noqa: E402
+    _state_spec as _act_spec)
 
 
 def _ring_spec(ndim: int, seq_parallel: bool = False) -> P:
@@ -87,16 +86,21 @@ def one_f_one_b(feed_fn: Callable,
                 num_micro_batch: int,
                 *,
                 stage_aux_weight: float = 0.0,
-                seq_parallel: bool = False) -> Callable:
+                seq_parallel: bool = False,
+                stage_extra: Optional[tuple] = None) -> Callable:
   """Build an interleaved-1F1B pipeline gradient function.
 
   Contracts (all pure functions; `rng` may be None throughout):
 
     feed_fn(feed_params, mb, rng) -> x          # embedding/pre-stage
-    stage_fn(stage_row_params, x, rng) -> (y, aux_scalar)
+    stage_fn(stage_row_params, x, rng, *extra) -> (y, aux_scalar)
                                                 # ONE stage, shape-preserving
     emit_fn(emit_params, y, mb, rng) -> (loss, aux_dict)
                                                 # head + per-micro-batch loss
+
+  `stage_extra`: optional tuple of arrays with leading [S] dim whose rows
+  are passed as non-differentiated extra args to `stage_fn` (e.g. the
+  per-stage active-block count of a heterogeneous model).
 
   `stage_row_params` is one row of the stage-stacked tree (leading dim S).
   `aux_scalar` is a differentiable per-stage auxiliary loss (e.g. MoE load
@@ -133,11 +137,13 @@ def one_f_one_b(feed_fn: Callable,
   def _emit_rng(rng, m):
     return None if rng is None else jax.random.fold_in(rng, S * M + M + m)
 
-  def _stage_call(p_row, x, r):
-    y, aux = stage_fn(p_row, x, r)
+  def _stage_call(p_row, x, r, extra):
+    y, aux = stage_fn(p_row, x, r, *extra)
     # Pin the aux aval (dtype + weak_type) so the backward cotangent we
     # seed for it always matches.
     return y, jnp.asarray(aux, jnp.float32) * jnp.ones((), jnp.float32)
+
+  extra_rows = tuple(stage_extra) if stage_extra is not None else ()
 
   def grad_fn(feed_params, stage_params, emit_params, mbs, rng,
               loss_scale=None):
@@ -176,10 +182,11 @@ def one_f_one_b(feed_fn: Callable,
       R = jax.vmap(write)(R, shifted, slot_w, valid_f)
       R = _constrain(R, _ring_spec(R.ndim, seq_parallel))
 
-      def fwd_one(p_row, x, m, s):
-        return _stage_call(p_row, x, _mb_rng(rng, m, s))
+      def fwd_one(p_row, x, m, s, extra):
+        return _stage_call(p_row, x, _mb_rng(rng, m, s), extra)
 
-      Y, aux_s = jax.vmap(fwd_one)(stage_params, shifted, mf_c, s_idx)
+      Y, aux_s = jax.vmap(fwd_one)(stage_params, shifted, mf_c, s_idx,
+                                   extra_rows)
       Y = _constrain(Y, _act_spec(Y.ndim, seq_parallel))
       stage_aux_sum = stage_aux_sum + jnp.sum(
           jnp.where(valid_f, aux_s, 0.0))
@@ -218,15 +225,17 @@ def one_f_one_b(feed_fn: Callable,
           lambda r_row, i: jax.lax.dynamic_index_in_dim(
               r_row, i, 0, keepdims=False))(R, slot_r)
 
-      def bwd_one(p_row, x, ct, m, s):
+      def bwd_one(p_row, x, ct, m, s, extra):
         r = _mb_rng(rng, m, s)
         # Recompute the stage forward to get its VJP (per-stage remat —
         # the ring stores only boundary activations).
-        _, vjp = jax.vjp(lambda pp, xx: _stage_call(pp, xx, r), p_row, x)
+        _, vjp = jax.vjp(
+            lambda pp, xx: _stage_call(pp, xx, r, extra), p_row, x)
         dp, dx = vjp((ct, jnp.float32(stage_aux_weight) * seed))
         return dp, dx
 
-      dP, dX = jax.vmap(bwd_one)(stage_params, x_res, cot, mb_c, s_idx)
+      dP, dX = jax.vmap(bwd_one)(stage_params, x_res, cot, mb_c, s_idx,
+                                 extra_rows)
       dP = _mask_leading(dP, valid_b)
       dX = jnp.where(valid_b.reshape((S,) + (1,) * (dX.ndim - 1)),
                      dX, jnp.zeros_like(dX))
@@ -274,12 +283,5 @@ def one_f_one_b(feed_fn: Callable,
   return grad_fn
 
 
-def split_micro_batches(batch, num_micro_batch: int):
-  """[B, ...] -> [M, B/M, ...] on every leaf."""
-  def reshape(x):
-    b = x.shape[0]
-    if b % num_micro_batch != 0:
-      raise ValueError(
-          f"batch {b} not divisible by num_micro_batch {num_micro_batch}")
-    return x.reshape((num_micro_batch, b // num_micro_batch) + x.shape[1:])
-  return jax.tree_util.tree_map(reshape, batch)
+# Re-exported for the engine's callers; canonical home is utils.pytree.
+from easyparallellibrary_tpu.utils.pytree import split_micro_batches  # noqa: E402,F401

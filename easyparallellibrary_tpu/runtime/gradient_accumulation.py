@@ -25,8 +25,7 @@ def accumulate_gradients(grad_fn: Callable, num_micro_batch: int):
     return grad_fn
 
   def accumulated(params, batch, rng):
-    from easyparallellibrary_tpu.parallel.schedule_1f1b import (
-        split_micro_batches)
+    from easyparallellibrary_tpu.utils.pytree import split_micro_batches
     micro = split_micro_batches(batch, num_micro_batch)
 
     def body(carry, inp):

@@ -51,3 +51,15 @@ def tree_paths_and_leaves(tree) -> List[Tuple[str, Any]]:
 def tree_map_with_path_str(fn: Callable[[str, Any], Any], tree):
   return jax.tree_util.tree_map_with_path(
       lambda path, leaf: fn(path_str(path), leaf), tree)
+
+
+def split_micro_batches(batch, num_micro_batch: int):
+  """[B, ...] -> [M, B/M, ...] on every leaf (micro-batch slicing shared
+  by gradient accumulation and the pipeline schedules)."""
+  def reshape(x):
+    b = x.shape[0]
+    if b % num_micro_batch != 0:
+      raise ValueError(
+          f"batch {b} not divisible by num_micro_batch {num_micro_batch}")
+    return x.reshape((num_micro_batch, b // num_micro_batch) + x.shape[1:])
+  return jax.tree_util.tree_map(reshape, batch)

@@ -184,6 +184,57 @@ def test_1f1b_bounds_live_activations_vs_gpipe():
   assert b_bwd < b_fwd, (b_bwd, b_fwd)
 
 
+def test_stageblocks_mask_applies_exact_count():
+  """StageBlocks with n_active=k == StageBlocks(blocks_per_stage=k) on the
+  matching param subset — masked slots are true identities."""
+  epl.init()
+  cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+                  d_ff=64, max_seq_len=16, dtype=jnp.float32)
+  from easyparallellibrary_tpu.models.gpt import StageBlocks
+  x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 32), jnp.float32)
+  big = StageBlocks(cfg, blocks_per_stage=3)
+  small = StageBlocks(cfg, blocks_per_stage=2)
+  params = big.init(jax.random.PRNGKey(0), x)["params"]
+  sub = {k: v for k, v in params.items() if k in ("block_0", "block_1")}
+  out_masked = big.apply({"params": params}, x, 2)
+  out_small = small.apply({"params": sub}, x)
+  np.testing.assert_allclose(np.asarray(out_masked), np.asarray(out_small),
+                             rtol=1e-5, atol=1e-6)
+
+
+def test_gpt_uneven_layers_pipeline_and_1f1b_match_sequential():
+  """num_layers % stages != 0 trains: both the GPipe module path and the
+  1F1B engine agree with the sequential ground truth (VERDICT item 5;
+  reference analog: arbitrary per-stage subgraphs,
+  epl/parallel/graph_editor.py:423-443)."""
+  env = epl.init()
+  mesh = env.cluster.build_mesh(stage=2)
+  base = dict(vocab_size=64, num_layers=5, num_heads=4, d_model=32,
+              d_ff=64, max_seq_len=16, dtype=jnp.float32,
+              pipeline_stages=2, num_micro_batch=4)
+  pp = GPT(GPTConfig(**base))
+  seq = GPT(GPTConfig(**base, pipeline_debug_sequential=True))
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (16, 17)),
+                    jnp.int32)
+  params = pp.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+  # ceil(5/2)=3 block slots per stage; stage 0 active=3, stage 1 active=2.
+  stacked = params["pipeline"]["stages"]["stacked"]
+  assert "block_2" in stacked
+
+  l_pp, _ = jax.jit(lambda p: gpt_loss(pp, p, {"ids": ids}))(params)
+  l_seq, _ = jax.jit(lambda p: gpt_loss(seq, p, {"ids": ids}))(params)
+  np.testing.assert_allclose(float(l_pp), float(l_seq), rtol=1e-5)
+
+  g_seq = jax.jit(jax.grad(lambda p: gpt_loss(seq, p, {"ids": ids})[0]))(
+      params)
+  grad_1f1b = make_gpt_1f1b_grad_fn(pp)
+  (l1, _), g1 = jax.jit(lambda p: grad_1f1b(p, {"ids": ids}, None))(params)
+  np.testing.assert_allclose(float(l1), float(l_seq), rtol=1e-5)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-5),
+      g1, g_seq)
+
+
 def test_1f1b_composes_amp_and_grouped_apply():
   """AMP loss scaling and PreferBackwardOptimizer's grouped apply compose
   around the 1F1B gradient path via build_train_step."""
