@@ -1,4 +1,6 @@
-from easyparallellibrary_tpu.models.gpt import GPT, GPTConfig
+from easyparallellibrary_tpu.models.gpt import (
+    GPT, GPTConfig, auto_parallel_gpt, make_gpt_train_step,
+)
 from easyparallellibrary_tpu.models.bert import (
     Bert, BertConfig, bert_large_config,
 )
@@ -7,6 +9,7 @@ from easyparallellibrary_tpu.models.resnet import (
 )
 
 __all__ = [
-    "GPT", "GPTConfig", "Bert", "BertConfig", "bert_large_config",
+    "GPT", "GPTConfig", "auto_parallel_gpt", "make_gpt_train_step",
+    "Bert", "BertConfig", "bert_large_config",
     "ResNet", "ResNetConfig", "resnet18_config", "resnet50_config",
 ]

@@ -71,6 +71,9 @@ class GPTConfig:
   # bubble fraction is unchanged — true interleaved scheduling is a
   # deferred item, see NOTES.md).
   pipeline_interleave: int = 1
+  # Explicit per-chunk block counts (len == stages*interleave), e.g. from
+  # the auto-parallel planner; overrides the default even/ceil layout.
+  stage_plan: Optional[tuple] = None
 
 
 def _act_spec(cfg: GPTConfig, ndim: int = 3) -> P:
@@ -201,7 +204,8 @@ class StageBlocks(nn.Module):
     return x
 
 
-def stage_layout(num_layers: int, num_chunks: int):
+def stage_layout(num_layers: int, num_chunks: int,
+                 stage_plan: Optional[tuple] = None):
   """Distribute blocks over pipeline chunks.
 
   Returns ``(blocks_per_chunk, n_active)``: even models get
@@ -209,7 +213,21 @@ def stage_layout(num_layers: int, num_chunks: int):
   slots per chunk with ``n_active[c]`` real blocks in chunk ``c`` (the
   first ``L % chunks`` chunks carry the extra block) — masked-identity
   slots make the stacked trunk homogeneous (see StageBlocks).
+
+  ``stage_plan`` (e.g. from the auto-parallel planner) pins the per-chunk
+  counts explicitly.
   """
+  if stage_plan is not None:
+    counts = tuple(int(c) for c in stage_plan)
+    if len(counts) != num_chunks or sum(counts) != num_layers \
+        or min(counts) < 1:
+      raise ValueError(
+          f"stage_plan {counts} must hold {num_chunks} positive counts "
+          f"summing to num_layers={num_layers}")
+    slots = max(counts)
+    if all(c == slots for c in counts):
+      return slots, None
+    return slots, counts
   if num_layers % num_chunks == 0:
     return num_layers // num_chunks, None
   base, rem = divmod(num_layers, num_chunks)
@@ -249,7 +267,8 @@ class GPT(nn.Module):
       from easyparallellibrary_tpu.strategies.scheduler import get_scheduler
       K = max(1, cfg.pipeline_interleave)
       chunks = cfg.pipeline_stages * K
-      blocks_per_chunk, n_active = stage_layout(cfg.num_layers, chunks)
+      blocks_per_chunk, n_active = stage_layout(cfg.num_layers, chunks,
+                                                cfg.stage_plan)
       if n_active is not None and cfg.num_experts > 0:
         raise ValueError(
             f"num_layers={cfg.num_layers} must divide evenly into "
@@ -356,7 +375,9 @@ def make_gpt_1f1b_grad_fn(model: GPT):
     raise ValueError("1F1B with pipeline_interleave > 1 (interleaved "
                      "schedule) is not supported yet; use interleave=1")
   S, M = cfg.pipeline_stages, cfg.num_micro_batch
-  if cfg.num_experts > 0 and cfg.num_layers % S != 0:
+  blocks_per_stage, n_active = stage_layout(cfg.num_layers, S,
+                                            cfg.stage_plan)
+  if cfg.num_experts > 0 and n_active is not None:
     # Same guard as GPT.__call__: masked identity slots would still sow
     # MoE aux losses (matters when params bypass GPT.init, e.g. restored
     # checkpoints).
@@ -374,8 +395,6 @@ def make_gpt_1f1b_grad_fn(model: GPT):
                  parallel="column" if cfg.tensor_parallel else "none",
                  use_bias=False, dtype=cfg.dtype,
                  param_dtype=cfg.param_dtype)
-
-  blocks_per_stage, n_active = stage_layout(cfg.num_layers, S)
 
   def build(train: bool):
     stage_mod = StageBlocks(cfg, blocks_per_stage=blocks_per_stage,
@@ -451,6 +470,60 @@ def make_gpt_1f1b_grad_fn(model: GPT):
     return (loss, metrics), grads
 
   return grad_fn
+
+
+def auto_parallel_gpt(cfg: GPTConfig, config=None) -> GPT:
+  """Auto-parallel model build: plan pipeline stages automatically.
+
+  When ``auto.auto_parallel`` is on and ``pipeline.num_stages > 1``, the
+  stage layout comes from :class:`parallel.planner.AutoStageGenerator`
+  over per-block FLOP weights and lands in ``GPTConfig.stage_plan``.
+  This is the build-time trigger the reference fires from its graph hooks
+  (epl/parallel/hooks.py:129-135 → planner → partition); here the planner
+  output flows directly into model construction.  With auto off (or
+  stages already pinned) the config passes through unchanged.
+
+  Only transformer blocks are planned: embedding and LM head execute
+  outside the stacked trunk (before/after the Pipeline; feed/emit in the
+  1F1B engine), and the lockstep SPMD trunk's per-tick cost is
+  ``max(counts)`` block slots on *every* stage — so weighting the
+  boundary stages by vocab size would buy nothing and cost extra masked
+  slots.  The planner balances the blocks' own weights, which for a
+  uniform model reproduces the optimal ceil split (uneven counts exactly
+  when ``num_layers % chunks != 0``).
+  """
+  import dataclasses as _dc
+  from easyparallellibrary_tpu.env import Env
+  from easyparallellibrary_tpu.parallel.planner import AutoStageGenerator
+
+  conf = config if config is not None else Env.get().config
+  N = conf.pipeline.num_stages
+  if not conf.auto.auto_parallel or N <= 1 or cfg.pipeline_stages > 1:
+    return GPT(cfg)
+
+  K = max(1, cfg.pipeline_interleave)
+  chunks = N * K
+  L, D, F = cfg.num_layers, cfg.d_model, cfg.d_ff
+  if L < chunks:
+    raise ValueError(
+        f"auto-parallel needs num_layers >= stages*interleave "
+        f"({L} < {chunks}); reduce pipeline.num_stages")
+  # Per-token matmul FLOP weights (the planner only needs ratios; MoE
+  # top-1 blocks activate the same matmul count as dense blocks).
+  block_w = float(4 * D * D + 2 * D * F + 2 * D * cfg.max_seq_len)
+  names = [f"block_{i}" for i in range(L)]
+  gen = AutoStageGenerator(num_stages=chunks)
+  stages = gen.search(names, block_params={n: block_w for n in names})
+  counts = tuple(len(s) for s in stages)
+  if len(counts) != chunks or min(counts) < 1:
+    raise ValueError(
+        f"auto stage search produced an invalid plan {counts} for "
+        f"{chunks} chunks over {L} blocks")
+  mb = conf.pipeline.num_micro_batch
+  cfg2 = _dc.replace(
+      cfg, pipeline_stages=N, stage_plan=counts,
+      num_micro_batch=mb if mb > 1 else max(cfg.num_micro_batch, 1))
+  return GPT(cfg2)
 
 
 def make_gpt_train_step(model: GPT, config=None):

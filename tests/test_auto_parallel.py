@@ -1,0 +1,108 @@
+"""Auto-parallel end-to-end: config -> planner -> partitioned pipeline ->
+training (reference analog: epl/parallel/hooks.py:129-135 triggering
+AutoStageGenerator from the build, tests/auto_parallel_test.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.models import GPT, GPTConfig, auto_parallel_gpt
+from easyparallellibrary_tpu.models.gpt import (
+    gpt_loss, make_gpt_train_step, stage_layout)
+
+
+def _base(**kw):
+  base = dict(vocab_size=2048, num_layers=6, num_heads=4, d_model=32,
+              d_ff=64, max_seq_len=16, dtype=jnp.float32)
+  base.update(kw)
+  return GPTConfig(**base)
+
+
+def test_auto_parallel_derives_stage_plan():
+  """Planner output lands in stage_plan: even models get the even split,
+  uneven models the min-max-balanced uneven counts."""
+  epl.init(epl.Config({"auto.auto_parallel": True,
+                       "pipeline.num_stages": 4,
+                       "pipeline.num_micro_batch": 4}))
+  even = auto_parallel_gpt(_base(num_layers=8))
+  assert even.cfg.pipeline_stages == 4
+  assert even.cfg.num_micro_batch == 4
+  assert even.cfg.stage_plan == (2, 2, 2, 2)
+
+  uneven = auto_parallel_gpt(_base(num_layers=7))
+  plan = uneven.cfg.stage_plan
+  assert sum(plan) == 7 and len(plan) == 4 and min(plan) >= 1
+  assert max(plan) == 2  # min-max balance: no stage hoards blocks
+
+
+def test_auto_parallel_rejects_too_many_stages():
+  import pytest
+  epl.init(epl.Config({"auto.auto_parallel": True,
+                       "pipeline.num_stages": 4}))
+  with pytest.raises(ValueError):
+    auto_parallel_gpt(_base(num_layers=3))
+
+
+def test_auto_parallel_off_passthrough():
+  epl.init()  # auto off by default
+  model = auto_parallel_gpt(_base())
+  assert model.cfg.pipeline_stages == 1
+  assert model.cfg.stage_plan is None
+
+
+def test_auto_partitioned_gpt_trains_and_matches_manual():
+  """VERDICT done-criterion: auto-partitioned GPT with uneven block
+  weights trains; its loss matches the manually partitioned model with
+  the same plan, and the sequential ground truth."""
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state, parallelize)
+
+  env = epl.init(epl.Config({"auto.auto_parallel": True,
+                             "pipeline.num_stages": 4,
+                             "pipeline.num_micro_batch": 4}))
+  mesh = env.cluster.build_mesh(stage=4)
+  auto_model = auto_parallel_gpt(_base(num_layers=7))
+  plan = auto_model.cfg.stage_plan
+  assert sorted(plan) == [1, 2, 2, 2]  # the interesting (uneven) case
+
+  manual = GPT(GPTConfig(**{**auto_model.cfg.__dict__}))  # same plan
+  seq = GPT(GPTConfig(**{**auto_model.cfg.__dict__,
+                         "pipeline_debug_sequential": True}))
+
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 2048, (16, 17)),
+                    jnp.int32)
+  params = auto_model.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+  l_auto, _ = jax.jit(lambda p: gpt_loss(auto_model, p, {"ids": ids}))(params)
+  l_manual, _ = jax.jit(lambda p: gpt_loss(manual, p, {"ids": ids}))(params)
+  l_seq, _ = jax.jit(lambda p: gpt_loss(seq, p, {"ids": ids}))(params)
+  np.testing.assert_allclose(float(l_auto), float(l_manual), rtol=1e-6)
+  np.testing.assert_allclose(float(l_auto), float(l_seq), rtol=1e-5)
+
+  def init_fn(rng):
+    return TrainState.create(
+        apply_fn=auto_model.apply,
+        params=auto_model.init(rng, ids[:, :-1])["params"],
+        tx=optax.adam(1e-2))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+  step = parallelize(make_gpt_train_step(auto_model), mesh, shardings)
+  losses = []
+  for i in range(6):
+    state, m = step(state, {"ids": ids}, jax.random.PRNGKey(i))
+    losses.append(float(m["loss"]))
+  assert losses[-1] < losses[0]
+
+
+def test_stage_plan_validation():
+  import pytest
+  with pytest.raises(ValueError):
+    stage_layout(6, 2, stage_plan=(5, 2))   # sums to 7
+  with pytest.raises(ValueError):
+    stage_layout(6, 2, stage_plan=(6, 0))   # zero-count stage
+  with pytest.raises(ValueError):
+    stage_layout(6, 3, stage_plan=(3, 3))   # wrong length
+  assert stage_layout(6, 2, stage_plan=(3, 3)) == (3, None)
+  assert stage_layout(6, 2, stage_plan=(4, 2)) == (4, (4, 2))
