@@ -1,0 +1,114 @@
+"""ZeRO memory benchmark — measured per-device bytes, off vs v0 vs v1.
+
+Runs on the 8-virtual-device CPU mesh (dp=8) so the deltas are real
+sharding effects, not estimates; on a healthy multi-chip TPU the same
+code measures HBM.  Prints one JSON line:
+
+  {"zero_off": {...}, "zero_v0": {...}, "zero_v1": {...}}
+
+with per-config argument (resident state) and temp bytes from XLA's
+memory_analysis — the artifact VERDICT item 6 asks for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+if __name__ == "__main__" and not os.environ.get("EPL_ZM_CHILD"):
+  # The outer env pins JAX_PLATFORMS to the (possibly wedged) remote-TPU
+  # plugin and sitecustomize registers it in every process — re-exec
+  # with a CPU-forced env so the dp=8 virtual mesh always works (the
+  # same recipe as __graft_entry__.dryrun_multichip).
+  import subprocess
+  env = dict(os.environ, JAX_PLATFORMS="cpu", EPL_ZM_CHILD="1")
+  flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                   if "xla_force_host_platform_device_count" not in f)
+  env["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+  raise SystemExit(subprocess.run(
+      [sys.executable, os.path.abspath(__file__)], env=env,
+      timeout=600).returncode)
+
+import jax
+
+# Belt and braces against the sitecustomize latch within this process.
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.parallel import (
+    TrainState, create_sharded_train_state, make_train_step, parallelize)
+from easyparallellibrary_tpu.runtime.zero import make_zero1_train_step
+
+
+class Net(nn.Module):
+  width: int = 2048
+
+  @nn.compact
+  def __call__(self, x):
+    x = nn.Dense(self.width)(x)
+    x = jnp.tanh(x)
+    return nn.Dense(64)(x)
+
+
+def measure(zero_level: str):
+  env = epl.init(epl.Config({"zero.level": zero_level} if zero_level
+                            else {}))
+  with epl.replicate(1):
+    model = Net()
+  mesh = epl.current_plan().build_mesh()
+  x = jnp.ones((32, 512))
+  y = jnp.ones((32, 64))
+  tx = optax.adam(1e-3)
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(rng, x)["params"], tx=tx)
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0), zero_level=zero_level)
+
+  def loss_fn(params, batch, rng):
+    pred = model.apply({"params": params}, batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2), {}
+
+  batch = {"x": x, "y": y}
+  rng = jax.random.PRNGKey(1)
+  if zero_level == "v1":
+    step = make_zero1_train_step(loss_fn, mesh)
+    step(state, batch, rng)                      # builds step.jitted
+    state2, _ = create_sharded_train_state(
+        init_fn, mesh, jax.random.PRNGKey(0), zero_level=zero_level)
+    mem = step.jitted.lower(state2, batch, rng).compile().memory_analysis()
+  else:
+    step = parallelize(make_train_step(loss_fn), mesh, shardings)
+    mem = step.jitted.lower(state, batch, rng).compile().memory_analysis()
+  return {
+      "argument_bytes": int(mem.argument_size_in_bytes),
+      "temp_bytes": int(mem.temp_size_in_bytes),
+      "output_bytes": int(mem.output_size_in_bytes),
+  }
+
+
+def main():
+  out = {}
+  for name, level in [("zero_off", ""), ("zero_v0", "v0"),
+                      ("zero_v1", "v1")]:
+    out[name] = measure(level)
+  off = out["zero_off"]["argument_bytes"]
+  v1 = out["zero_v1"]["argument_bytes"]
+  out["v1_vs_off_argument_ratio"] = round(v1 / off, 4)
+  print(json.dumps(out))
+
+
+if __name__ == "__main__":
+  main()

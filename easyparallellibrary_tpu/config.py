@@ -212,6 +212,8 @@ class AMPConfig(_Category):
       # needs no scaling; kept for fp16 parity, reference
       # epl/runtime/amp/loss_scale.py).
       "loss_scale": "dynamic",
+      # Compute dtype under O1: "bf16" (TPU-native) | "fp16".
+      "compute_dtype": "bf16",
       "debug_log": False,
   }
 
@@ -308,6 +310,9 @@ class Config:
     if self.amp.level not in ("", constants.AMP_O0, constants.AMP_O1):
       raise ValueError(f"amp.level must be '', 'O0' or 'O1'; "
                        f"got {self.amp.level!r}")
+    if self.amp.compute_dtype not in ("bf16", "fp16"):
+      raise ValueError(f"amp.compute_dtype must be 'bf16' or 'fp16'; "
+                       f"got {self.amp.compute_dtype!r}")
     if self.gradient_checkpoint.type not in (
         "", constants.GC_COLLECTION, constants.GC_AUTO):
       raise ValueError("gradient_checkpoint.type must be '', 'collection' "

@@ -102,7 +102,8 @@ class Bert(nn.Module):
 
   @nn.compact
   def __call__(self, ids, type_ids=None):
-    cfg = self.cfg
+    from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
+    cfg = resolve_model_dtypes(self.cfg)
     B, S = ids.shape
     tok = Embedding(cfg.vocab_size, cfg.d_model,
                     parallel="vocab" if cfg.tensor_parallel else "none",
@@ -172,7 +173,8 @@ class BertEncoderTrunk(nn.Module):
 
   @nn.compact
   def __call__(self, ids, type_ids=None):
-    cfg = self.cfg
+    from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
+    cfg = resolve_model_dtypes(self.cfg)
     B, S = ids.shape
     tok = Embedding(cfg.vocab_size, cfg.d_model,
                     parallel="vocab" if cfg.tensor_parallel else "none",

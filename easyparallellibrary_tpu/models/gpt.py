@@ -86,8 +86,23 @@ def _act_spec(cfg: GPTConfig, ndim: int = 3) -> P:
 from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
 
 
+def _dense_causal_attention(q, k, v, dtype):
+  """Reference XLA attention: bf16 matmuls, fp32 softmax, causal mask.
+  Shared by the training path and the KV-cache prefill so the two can
+  never drift apart numerically."""
+  S = q.shape[1]
+  scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(dtype)
+  logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+  mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+  logits = jnp.where(mask[None, None], logits,
+                     jnp.asarray(-1e9, logits.dtype))
+  probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+  return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dtype), v)
+
+
 class CausalSelfAttention(nn.Module):
   cfg: GPTConfig
+  decode: bool = False
 
   @nn.compact
   def __call__(self, x):
@@ -107,7 +122,9 @@ class CausalSelfAttention(nn.Module):
                             constants.MODEL_AXIS, None))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
-    if cfg.attn_impl == "ring":
+    if self.decode:
+      out = self._decode_attend(q, k, v)
+    elif cfg.attn_impl == "ring":
       from easyparallellibrary_tpu.sequence.ring_attention import (
           ring_attention)
       out = ring_attention(q, k, v, causal=True)
@@ -119,18 +136,51 @@ class CausalSelfAttention(nn.Module):
           flash_attention)
       out = flash_attention(q, k, v, causal=True)
     else:
-      scale = 1.0 / jnp.sqrt(head_dim).astype(cfg.dtype)
-      logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-      mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-      logits = jnp.where(mask[None, None], logits,
-                         jnp.asarray(-1e9, logits.dtype))
-      probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-      out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
+      out = _dense_causal_attention(q, k, v, cfg.dtype)
 
     out = out.reshape(B, S, D)
     out = Dense(D, parallel=row, use_bias=False, dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype, name="proj")(out)
     return _constrain(out, _act_spec(cfg))
+
+  def _decode_attend(self, q, k, v):
+    """KV-cached attention (VERDICT round-1 item 10).
+
+    Prefill (S > 1): normal causal attention; the prompt's K/V land in
+    the cache.  Step (S == 1): append this token's K/V at the cache
+    cursor and attend over the valid prefix — O(1) forwards per token
+    instead of the full-forward-per-token fallback.
+    """
+    cfg = self.cfg
+    B, S, H, hd = q.shape
+    L = cfg.max_seq_len
+    ck = self.variable("cache", "cached_key",
+                       lambda: jnp.zeros((B, L, H, hd), cfg.dtype))
+    cv = self.variable("cache", "cached_value",
+                       lambda: jnp.zeros((B, L, H, hd), cfg.dtype))
+    ci = self.variable("cache", "cache_index",
+                       lambda: jnp.zeros((), jnp.int32))
+    scale = 1.0 / jnp.sqrt(hd).astype(cfg.dtype)
+
+    if S > 1:  # prefill
+      ck.value = jax.lax.dynamic_update_slice(
+          ck.value, k.astype(cfg.dtype), (0, 0, 0, 0))
+      cv.value = jax.lax.dynamic_update_slice(
+          cv.value, v.astype(cfg.dtype), (0, 0, 0, 0))
+      ci.value = jnp.int32(S)
+      return _dense_causal_attention(q, k, v, cfg.dtype)
+
+    idx = ci.value
+    ck.value = jax.lax.dynamic_update_slice(
+        ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+    cv.value = jax.lax.dynamic_update_slice(
+        cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+    ci.value = idx + 1
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) * scale  # k over L
+    valid = (jnp.arange(L) <= idx)[None, None, None, :]
+    logits = jnp.where(valid, logits, jnp.asarray(-1e9, logits.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), cv.value)
 
 
 class MLP(nn.Module):
@@ -153,6 +203,7 @@ class Block(nn.Module):
   cfg: GPTConfig
   use_moe: bool = False
   deterministic: bool = True
+  decode: bool = False
 
   @nn.compact
   def __call__(self, x):
@@ -161,7 +212,8 @@ class Block(nn.Module):
                       deterministic=self.deterministic
                       or cfg.dropout_rate == 0.0)
     y = LayerNorm(dtype=cfg.dtype, name="ln1")(x)
-    x = x + drop(CausalSelfAttention(cfg, name="attn")(y))
+    x = x + drop(CausalSelfAttention(cfg, decode=self.decode,
+                                     name="attn")(y))
     y = LayerNorm(dtype=cfg.dtype, name="ln2")(x)
     if self.use_moe:
       from easyparallellibrary_tpu.models.moe import MoEMLP
@@ -250,16 +302,36 @@ class GPT(nn.Module):
   cfg: GPTConfig
 
   @nn.compact
-  def __call__(self, ids, deterministic: bool = True):
-    cfg = self.cfg
+  def __call__(self, ids, deterministic: bool = True,
+               decode: bool = False):
+    from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
+    cfg = resolve_model_dtypes(self.cfg)
     B, S = ids.shape
+    if decode and cfg.pipeline_stages > 1:
+      raise ValueError("KV-cache decode is single-program; run generation "
+                       "on a non-pipelined config (pipeline_stages=1)")
     tok = Embedding(cfg.vocab_size, cfg.d_model,
                     parallel="vocab" if cfg.tensor_parallel else "none",
                     param_dtype=cfg.param_dtype, name="wte")
     pos_init = nn.initializers.normal(stddev=0.02)
     pos = self.param("wpe", nn.with_partitioning(pos_init, (None, None)), (cfg.max_seq_len, cfg.d_model),
                      cfg.param_dtype)
-    x = tok(ids).astype(cfg.dtype) + pos[None, :S].astype(cfg.dtype)
+    if decode:
+      # Absolute positions while stepping: the cursor mirrors the
+      # attention caches' index (prefill pins it to S).
+      pi = self.variable("cache", "pos_index",
+                         lambda: jnp.zeros((), jnp.int32))
+      if S > 1:  # prefill
+        offset = jnp.int32(0)
+        pi.value = jnp.int32(S)
+      else:
+        offset = pi.value
+        pi.value = pi.value + 1
+      pos_slice = jax.lax.dynamic_slice(
+          jnp.asarray(pos), (offset, 0), (S, cfg.d_model))
+    else:
+      pos_slice = jnp.asarray(pos)[:S]
+    x = tok(ids).astype(cfg.dtype) + pos_slice[None].astype(cfg.dtype)
     x = _constrain(x, _act_spec(cfg))
 
     if cfg.pipeline_stages > 1:
@@ -308,7 +380,7 @@ class GPT(nn.Module):
         use_moe = cfg.num_experts > 0 and \
           (i % cfg.moe_every == cfg.moe_every - 1)
         x = block_cls(cfg, use_moe=use_moe, deterministic=deterministic,
-                      name=f"block_{i}")(x)
+                      decode=decode, name=f"block_{i}")(x)
 
     x = LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
     if cfg.tie_embeddings:
@@ -367,8 +439,9 @@ def make_gpt_1f1b_grad_fn(model: GPT):
   """
   from easyparallellibrary_tpu.parallel.schedule_1f1b import (
       one_f_one_b, split_micro_batches)
+  from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
 
-  cfg = model.cfg
+  cfg = resolve_model_dtypes(model.cfg)
   if cfg.pipeline_stages <= 1:
     raise ValueError("1F1B needs pipeline_stages > 1")
   if cfg.pipeline_interleave > 1:
@@ -503,17 +576,17 @@ def auto_parallel_gpt(cfg: GPTConfig, config=None) -> GPT:
 
   K = max(1, cfg.pipeline_interleave)
   chunks = N * K
-  L, D, F = cfg.num_layers, cfg.d_model, cfg.d_ff
+  L = cfg.num_layers
   if L < chunks:
     raise ValueError(
         f"auto-parallel needs num_layers >= stages*interleave "
         f"({L} < {chunks}); reduce pipeline.num_stages")
-  # Per-token matmul FLOP weights (the planner only needs ratios; MoE
-  # top-1 blocks activate the same matmul count as dense blocks).
-  block_w = float(4 * D * D + 2 * D * F + 2 * D * cfg.max_seq_len)
+  # GPT trunk blocks are structurally uniform (MoE top-1 activates the
+  # same matmul count as dense), so the planner balances unit weights;
+  # plug per-block costs here if blocks ever become heterogeneous.
   names = [f"block_{i}" for i in range(L)]
   gen = AutoStageGenerator(num_stages=chunks)
-  stages = gen.search(names, block_params={n: block_w for n in names})
+  stages = gen.search(names)
   counts = tuple(len(s) for s in stages)
   if len(counts) != chunks or min(counts) < 1:
     raise ValueError(
@@ -572,13 +645,14 @@ def make_gpt_train_step(model: GPT, config=None):
 
 
 def generate(model: GPT, params, prompt_ids, max_new_tokens: int,
-             temperature: float = 0.0, rng=None):
+             temperature: float = 0.0, rng=None, use_cache: bool = True):
   """Autoregressive decoding; returns [B, prompt + max_new_tokens].
 
-  Each step re-runs the full forward (causality guarantees the not-yet-
-  generated tail cannot influence the next-token logits), so no KV-cache
-  state is threaded — simple and correct; a cached decode path is a
-  deferred optimization (NOTES.md).  ``temperature=0`` is greedy.
+  With ``use_cache`` (default), each layer keeps a K/V cache: one prefill
+  over the prompt, then O(1) forwards per generated token (VERDICT
+  round-1 item 10).  ``use_cache=False`` (or a pipelined config) falls
+  back to re-running the full forward per token — the simple path the
+  cached one is tested against.  ``temperature=0`` is greedy.
   """
   B, plen = prompt_ids.shape
   if plen == 0:
@@ -592,16 +666,43 @@ def generate(model: GPT, params, prompt_ids, max_new_tokens: int,
   ids = jnp.zeros((B, total), jnp.int32).at[:, :plen].set(prompt_ids)
   rng = rng if rng is not None else jax.random.PRNGKey(0)
 
+  def pick(next_logits, t):
+    if temperature > 0:
+      step_rng = jax.random.fold_in(rng, t)
+      return jax.random.categorical(
+          step_rng, next_logits / temperature, axis=-1)
+    return jnp.argmax(next_logits, axis=-1)
+
+  if max_new_tokens <= 0:
+    return ids
+
+  if use_cache and model.cfg.pipeline_stages <= 1:
+    # Prefill: one full forward over the prompt populates the caches.
+    logits, vars = model.apply({"params": params}, prompt_ids,
+                               decode=True, mutable=["cache"])
+    nxt = pick(logits[:, plen - 1], plen)
+    ids = jax.lax.dynamic_update_slice_in_dim(
+        ids, nxt[:, None].astype(jnp.int32), plen, axis=1)
+
+    def body(t, carry):
+      ids, cache = carry
+      tok = jax.lax.dynamic_slice_in_dim(ids, t - 1, 1, axis=1)
+      logits, vars = model.apply({"params": params, "cache": cache}, tok,
+                                 decode=True, mutable=["cache"])
+      nxt = pick(logits[:, 0], t)
+      ids = jax.lax.dynamic_update_slice_in_dim(
+          ids, nxt[:, None].astype(jnp.int32), t, axis=1)
+      return ids, vars["cache"]
+
+    ids, _ = jax.lax.fori_loop(plen + 1, total, body,
+                               (ids, vars["cache"]))
+    return ids
+
   def body(t, ids):
     logits = model.apply({"params": params}, ids)
     next_logits = jax.lax.dynamic_slice_in_dim(
         logits, t - 1, 1, axis=1)[:, 0]            # [B, vocab]
-    if temperature > 0:
-      step_rng = jax.random.fold_in(rng, t)
-      nxt = jax.random.categorical(
-          step_rng, next_logits / temperature, axis=-1)
-    else:
-      nxt = jnp.argmax(next_logits, axis=-1)
+    nxt = pick(next_logits, t)
     return jax.lax.dynamic_update_slice_in_dim(
         ids, nxt[:, None].astype(jnp.int32), t, axis=1)
 

@@ -76,7 +76,8 @@ class ResNet(nn.Module):
 
   @nn.compact
   def __call__(self, x):
-    cfg = self.cfg
+    from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
+    cfg = resolve_model_dtypes(self.cfg)
     x = x.astype(cfg.dtype)
     x = nn.Conv(cfg.num_filters, (7, 7), strides=(2, 2), use_bias=False,
                 dtype=cfg.dtype, param_dtype=cfg.param_dtype,

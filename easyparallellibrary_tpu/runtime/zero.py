@@ -11,18 +11,21 @@ reduce-scatter(grads) → local apply → all-gather(params) automatically,
 which is exactly the ZeRO-1 dataflow.
 
 Levels (reference epl/config.py:129-137):
-  * v0 — shard optimizer states only.
-  * v1 — v0 + gradients: the train step additionally reduce-scatters
-    gradients explicitly when running inside a shard_map region; under
-    plain GSPMD jit the partitioner already fuses this, so v1 ≡ v0 there.
+  * v0 — shard optimizer states only (GSPMD sharding decision, below).
+  * v1 — v0 + gradients: :func:`make_zero1_train_step` runs the step
+    inside shard_map and spells the ZeRO-1 dataflow out explicitly —
+    reduce-scatter(grads) → owner applies its shard → all-gather(params)
+    — matching the reference's reduce-to-owner + broadcast choreography
+    (epl/runtime/zero.py:178-190, :129-167) with XLA collectives.
   * v2 — not implemented (the reference declares it unimplemented too).
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -82,3 +85,124 @@ def shard_opt_state(abstract_state, shardings, mesh: Mesh, level: str):
   new_opt_shardings = jax.tree_util.tree_map(
       reshard, abstract_opt, shardings.opt_state)
   return shardings.replace(opt_state=new_opt_shardings)
+
+
+# --------------------------------------------------------------------------
+# Explicit ZeRO-1: reduce-scatter grads to owners, local apply, all-gather.
+# --------------------------------------------------------------------------
+
+def _zero1_dim(shape, dp: int):
+  """The dimension a leaf is owner-sharded on, or None when it stays
+  replicated (the analog of the reference keeping remainder vars on
+  worker 0, epl/runtime/zero.py:105-115).  Derived from
+  `_shard_leaf_spec` so the shard_map body and the state layouts built by
+  `create_sharded_train_state(zero_level=...)` can never disagree."""
+  import types
+  spec = _shard_leaf_spec(
+      types.SimpleNamespace(shape=tuple(shape)), P(), dp)
+  for d, entry in enumerate(spec):
+    if entry == constants.DATA_AXIS:
+      return d
+  return None
+
+
+def make_zero1_train_step(loss_fn: Callable, mesh: Mesh) -> Callable:
+  """Explicit ZeRO-1 train step: `(state, batch, rng) -> (state, metrics)`.
+
+  Inside shard_map over the data axis:
+
+    1. per-shard gradients (full-size, like plain DP),
+    2. ``psum_scatter`` each divisible gradient leaf — every worker
+       receives only the 1/dp slice it owns (reference: reduce grads to
+       the owning worker, epl/runtime/zero.py:178-190),
+    3. the owner applies the optimizer update on its param/opt-state
+       slice (optimizer must be elementwise — adam/adamw/sgd; global-norm
+       transforms would need the full tree),
+    4. ``all_gather`` rebuilds the replicated params (reference's chained
+       broadcasts, :129-167 — here one fused collective).
+
+  Gradient + optimizer memory for sharded leaves is 1/dp per device by
+  construction, not by XLA's liveness choices.  Build the state with
+  ``create_sharded_train_state(..., zero_level="v1")`` — the explicit
+  step shards leaves on the same first-divisible dim that
+  ``shard_opt_state`` uses, so the layouts line up.
+  """
+  dp_axes = {constants.DATA_AXIS}
+  sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+  for name, size in sizes.items():
+    if name not in dp_axes and size > 1:
+      raise ValueError(
+          f"explicit ZeRO-1 supports pure data parallelism; mesh axis "
+          f"{name!r} has size {size} (compose GSPMD zero.level=v0 with "
+          f"hybrid meshes instead)")
+  dp = sizes.get(constants.DATA_AXIS, 1)
+
+  def sharded_step(state, batch, rng):
+    import optax
+    (loss, aux), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(state.params, batch, rng)
+    idx = jax.lax.axis_index(constants.DATA_AXIS)
+
+    def scatter(g):
+      d = _zero1_dim(g.shape, dp)
+      if d is not None:
+        return jax.lax.psum_scatter(
+            g, constants.DATA_AXIS, scatter_dimension=d, tiled=True) / dp
+      return jax.lax.pmean(g, constants.DATA_AXIS)
+
+    def slice_own(p):
+      d = _zero1_dim(p.shape, dp)
+      if d is not None:
+        block = p.shape[d] // dp
+        return jax.lax.dynamic_slice_in_dim(p, idx * block, block, axis=d)
+      return p
+
+    grads_own = jax.tree_util.tree_map(scatter, grads)
+    params_own = jax.tree_util.tree_map(slice_own, state.params)
+    updates, new_opt = state.tx.update(grads_own, state.opt_state,
+                                       params_own)
+    new_params_own = optax.apply_updates(params_own, updates)
+
+    def gather(ps, p_old):
+      d = _zero1_dim(p_old.shape, dp)
+      if d is not None:
+        return jax.lax.all_gather(ps, constants.DATA_AXIS, axis=d,
+                                  tiled=True)
+      return ps
+
+    new_params = jax.tree_util.tree_map(gather, new_params_own,
+                                        state.params)
+    new_state = state.replace(step=state.step + 1, params=new_params,
+                              opt_state=new_opt)
+    from easyparallellibrary_tpu.parallel.metrics import merge_shard_metrics
+    metrics = {"loss": jax.lax.pmean(loss, constants.DATA_AXIS)}
+    if aux:
+      metrics.update(merge_shard_metrics(
+          jax.tree_util.tree_map(jnp.asarray, aux)))
+    return new_state, metrics
+
+  def state_specs(state):
+    import flax.linen as nn
+
+    def opt_spec(leaf):
+      return _shard_leaf_spec(leaf, P(), dp)
+
+    specs = jax.tree_util.tree_map(lambda _: P(), nn.unbox(state))
+    return specs.replace(opt_state=jax.tree_util.tree_map(
+        opt_spec, nn.unbox(state.opt_state)))
+
+  compiled = {}
+
+  def step(state, batch, rng):
+    if "fn" not in compiled:
+      in_state_specs = state_specs(jax.eval_shape(lambda s: s, state))
+      mapped = jax.shard_map(
+          sharded_step, mesh=mesh,
+          in_specs=(in_state_specs, P(constants.DATA_AXIS), P()),
+          out_specs=(in_state_specs, P()),
+          check_vma=False)
+      compiled["fn"] = jax.jit(mapped, donate_argnums=(0,))
+      step.jitted = compiled["fn"]
+    return compiled["fn"](state, batch, rng)
+
+  return step

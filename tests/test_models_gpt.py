@@ -156,3 +156,27 @@ def test_generate_greedy_and_sampled():
   import pytest
   with pytest.raises(ValueError):
     generate(model, params, jnp.zeros((1, 15), jnp.int32), 10)  # > max_seq
+
+
+def test_generate_kv_cache_matches_full_forward():
+  """The O(1)-per-token cached decode reproduces the full-forward path
+  exactly (greedy and sampled) — VERDICT round-1 item 10."""
+  from easyparallellibrary_tpu.models.gpt import generate
+  model = GPT(TINY)
+  prompt = jnp.asarray(np.random.RandomState(3).randint(0, 64, (2, 5)),
+                       jnp.int32)
+  params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+  cached = generate(model, params, prompt, 7)
+  full = generate(model, params, prompt, 7, use_cache=False)
+  np.testing.assert_array_equal(np.asarray(cached), np.asarray(full))
+
+  rng = jax.random.PRNGKey(9)
+  cached_s = generate(model, params, prompt, 7, temperature=0.8, rng=rng)
+  full_s = generate(model, params, prompt, 7, temperature=0.8, rng=rng,
+                    use_cache=False)
+  np.testing.assert_array_equal(np.asarray(cached_s), np.asarray(full_s))
+
+  # max_new_tokens=0 returns the prompt untouched on both paths.
+  np.testing.assert_array_equal(
+      np.asarray(generate(model, params, prompt, 0)), np.asarray(prompt))

@@ -69,3 +69,75 @@ def test_zero_training_matches_baseline():
 
   np.testing.assert_allclose(run("v0"), run(""), rtol=1e-5)
   np.testing.assert_allclose(run("v1"), run(""), rtol=1e-5)
+
+
+def _loss_fn(model):
+  def loss_fn(params, batch, rng):
+    pred = model.apply({"params": params}, batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2), {}
+  return loss_fn
+
+
+def test_explicit_zero1_matches_gspmd_baseline():
+  """The explicit reduce-scatter -> owner-apply -> all-gather step trains
+  identically to the implicit GSPMD path (reference: reduce-to-owner +
+  broadcast choreography, epl/runtime/zero.py:129-190)."""
+  from easyparallellibrary_tpu.runtime.zero import make_zero1_train_step
+
+  model, mesh, state, shardings, x = _build("v1")
+  y = jnp.ones((16, 8))
+  loss_fn = _loss_fn(model)
+  zstep = make_zero1_train_step(loss_fn, mesh)
+
+  base_model, base_mesh, base_state, base_shardings, _ = _build("")
+  bstep = parallelize(make_train_step(loss_fn), base_mesh, base_shardings)
+
+  rng = jax.random.PRNGKey(1)
+  for _ in range(5):
+    state, zm = zstep(state, {"x": x, "y": y}, rng)
+    base_state, bm = bstep(base_state, {"x": x, "y": y}, rng)
+    np.testing.assert_allclose(float(zm["loss"]), float(bm["loss"]),
+                               rtol=1e-5)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                              rtol=1e-4, atol=1e-6),
+      state.params, base_state.params)
+  # Optimizer state is genuinely sharded: adam mu for the 32x64 kernel
+  # holds a 1/8 slice per device.
+  mu = state.opt_state[0].mu["Dense_0"]["kernel"]
+  mu = mu.value if hasattr(mu, "value") else mu
+  assert mu.sharding.shard_shape(mu.shape) != mu.shape
+
+
+def test_explicit_zero1_reduces_per_device_state_bytes():
+  """Measured HBM claim (VERDICT item 6): compiled per-device argument
+  bytes of the v1 step are smaller than the unsharded-opt DP step."""
+  from easyparallellibrary_tpu.runtime.zero import make_zero1_train_step
+
+  model, mesh, state, shardings, x = _build("v1")
+  y = jnp.ones((16, 8))
+  loss_fn = _loss_fn(model)
+
+  zstep = make_zero1_train_step(loss_fn, mesh)
+  zstep(state, {"x": x, "y": y}, jax.random.PRNGKey(1))  # build + donate
+
+  base_model, base_mesh, base_state, base_shardings, _ = _build("")
+  bstep = parallelize(make_train_step(loss_fn), base_mesh, base_shardings)
+
+  # Fresh (undonated) state with the SAME pytree metadata for lowering;
+  # compare per-device argument (resident state) sizes.
+  def init_fn(rng):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(rng, x)["params"],
+                             tx=state.tx)
+
+  state2, _ = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0), zero_level="v1")
+  zmem = zstep.jitted.lower(
+      state2, {"x": x, "y": y}, jax.random.PRNGKey(1)
+  ).compile().memory_analysis()
+  bmem = bstep.jitted.lower(
+      base_state, {"x": x, "y": y}, jax.random.PRNGKey(1)
+  ).compile().memory_analysis()
+  assert zmem.argument_size_in_bytes < bmem.argument_size_in_bytes, (
+      zmem.argument_size_in_bytes, bmem.argument_size_in_bytes)
