@@ -73,24 +73,21 @@ class BoundedQueue {
   bool closed_ = false;
 };
 
-bool read_all_records(const std::string& path,
-                      std::vector<std::string>* out) {
-  FILE* f = std::fopen(path.c_str(), "rb");
-  if (!f) return false;
-  for (;;) {
-    uint64_t len_le = 0;
-    size_t n = std::fread(&len_le, 1, sizeof(len_le), f);
-    if (n == 0) break;               // clean EOF
-    if (n != sizeof(len_le)) { std::fclose(f); return false; }
-    std::string payload(len_le, '\0');
-    if (len_le && std::fread(&payload[0], 1, len_le, f) != len_le) {
-      std::fclose(f);
-      return false;
-    }
-    out->push_back(std::move(payload));
-  }
-  std::fclose(f);
-  return true;
+// Largest plausible record: guards against interpreting a non-record
+// file's first bytes as a multi-exabyte length (which would throw
+// bad_alloc on a worker thread and std::terminate the process).
+constexpr uint64_t kMaxRecordBytes = 1ull << 33;  // 8 GB
+
+// Reads ONE length-prefixed record. 1 = ok, 0 = clean EOF, -1 = error.
+int read_one_record(FILE* f, std::string* out) {
+  uint64_t len_le = 0;
+  size_t n = std::fread(&len_le, 1, sizeof(len_le), f);
+  if (n == 0) return 0;
+  if (n != sizeof(len_le)) return -1;
+  if (len_le > kMaxRecordBytes) return -1;  // corrupt / not a record file
+  out->assign(len_le, '\0');
+  if (len_le && std::fread(&(*out)[0], 1, len_le, f) != len_le) return -1;
+  return 1;
 }
 
 class Reader {
@@ -99,12 +96,25 @@ class Reader {
       : files_(std::move(files)),
         queue_(prefetch == 0 ? 1 : prefetch),
         num_threads_(num_threads < 1 ? 1 : num_threads) {
+    // Per-file staging queues: workers STREAM records into them (one
+    // record in flight per read call), so resident memory is bounded by
+    // queue capacities — never by file size.  Total bound:
+    // prefetch + num_files * per_file_cap records.
+    size_t workers = std::min<size_t>(num_threads_,
+                                      files_.empty() ? 1 : files_.size());
+    size_t cap = (prefetch == 0 ? 1 : prefetch) / workers;
+    per_file_cap_ = cap < 4 ? 4 : cap;
+    file_queues_.reserve(files_.size());
+    for (size_t i = 0; i < files_.size(); ++i) {
+      file_queues_.emplace_back(new BoundedQueue(per_file_cap_));
+    }
     producer_ = std::thread([this] { produce(); });
   }
 
   ~Reader() {
-    queue_.close();
     stop_.store(true);
+    for (auto& q : file_queues_) q->close();
+    queue_.close();
     if (producer_.joinable()) producer_.join();
   }
 
@@ -136,22 +146,33 @@ class Reader {
 
  private:
   // Files are read by a pool of worker threads (one file at a time per
-  // worker) but records are emitted in deterministic file order: workers
-  // pre-load whole files; the producer walks files in order and streams
-  // their records into the bounded queue.
+  // worker) but records are emitted in deterministic file order: each
+  // worker STREAMS its file's records into that file's bounded staging
+  // queue (blocking when full); the producer walks files in order and
+  // forwards records into the main bounded queue.  No whole-file
+  // buffering anywhere.
   void produce() {
     size_t n = files_.size();
-    std::vector<std::vector<std::string>> loaded(n);
-    std::vector<std::atomic<int>> ready(n);
-    for (auto& r : ready) r.store(0);
     std::atomic<size_t> next_file{0};
 
     auto worker = [&] {
       for (;;) {
         size_t i = next_file.fetch_add(1);
         if (i >= n || stop_.load()) return;
-        read_all_records(files_[i], &loaded[i]);
-        ready[i].store(1);
+        FILE* f = std::fopen(files_[i].c_str(), "rb");
+        if (f) {
+          for (;;) {
+            if (stop_.load()) { std::fclose(f); return; }
+            Record rec;
+            int rc = read_one_record(f, &rec.data);
+            if (rc != 1) break;           // EOF or malformed tail
+            file_queues_[i]->push(std::move(rec));
+          }
+          std::fclose(f);
+        }
+        Record eof;
+        eof.eof = true;
+        file_queues_[i]->push(std::move(eof));
       }
     };
     std::vector<std::thread> pool;
@@ -160,15 +181,11 @@ class Reader {
     for (size_t t = 0; t < workers; ++t) pool.emplace_back(worker);
 
     for (size_t i = 0; i < n && !stop_.load(); ++i) {
-      while (!ready[i].load() && !stop_.load())
-        std::this_thread::sleep_for(std::chrono::microseconds(50));
-      for (auto& rec : loaded[i]) {
-        if (stop_.load()) break;
+      for (;;) {
         Record r;
-        r.data = std::move(rec);
+        if (!file_queues_[i]->pop(&r) || r.eof) break;
         queue_.push(std::move(r));
       }
-      loaded[i].clear();
     }
     Record eof;
     eof.eof = true;
@@ -179,6 +196,8 @@ class Reader {
   std::vector<std::string> files_;
   BoundedQueue queue_;
   int num_threads_;
+  size_t per_file_cap_ = 4;
+  std::vector<std::unique_ptr<BoundedQueue>> file_queues_;
   std::thread producer_;
   std::atomic<bool> stop_{false};
   Record pending_;

@@ -85,7 +85,14 @@ def reduce_scatter(x, axis_name: str, axis: int = 0, op: str = SUM):
 
 def reduce(x, axis_name: str, root: int = 0, op: str = SUM):
   """Reduce-to-root (reference Reduce kernel:
-  csrc/communicators/nccl_reduce.cc:20-48).  Non-roots get zeros."""
+  csrc/communicators/nccl_reduce.cc:20-48).  Non-roots get zeros.
+
+  COST: a full all-reduce.  XLA's SPMD collective vocabulary has no
+  rooted reduce — every program runs the same collective, so NCCL's
+  cheaper one-receiver reduce is not expressible (rooted trees are a
+  host-topology concept; ICI collectives are ring/torus-wide).  If you
+  only need the value on one host afterwards, that is free — the result
+  is replicated.  Do not benchmark this as a NCCL-style reduce."""
   summed = all_reduce(x, axis_name, op=op)
   idx = lax.axis_index(axis_name)
   return jnp.where(idx == root, summed, jnp.zeros_like(summed))
@@ -96,7 +103,12 @@ def broadcast(x, axis_name: str, root: int = 0):
   csrc/communicators/nccl_broadcast.cc:20-46).
 
   Implemented as mask+psum: every rank contributes zeros except the root.
-  """
+
+  COST: a full all-reduce (~2x the bytes of NCCL's rooted broadcast).
+  Same SPMD constraint as :func:`reduce` — there is no one-to-all
+  primitive; a log-depth ppermute ladder would move MORE bytes because
+  every rank's buffer travels in each SPMD permute step.  Prefer keeping
+  values replicated (free under GSPMD) over broadcasting at runtime."""
   idx = lax.axis_index(axis_name)
   masked = jnp.where(idx == root, x, jnp.zeros_like(x))
   return lax.psum(masked, axis_name)

@@ -160,7 +160,6 @@ class CausalSelfAttention(nn.Module):
                        lambda: jnp.zeros((B, L, H, hd), cfg.dtype))
     ci = self.variable("cache", "cache_index",
                        lambda: jnp.zeros((), jnp.int32))
-    scale = 1.0 / jnp.sqrt(hd).astype(cfg.dtype)
 
     if S > 1:  # prefill
       ck.value = jax.lax.dynamic_update_slice(
@@ -170,6 +169,7 @@ class CausalSelfAttention(nn.Module):
       ci.value = jnp.int32(S)
       return _dense_causal_attention(q, k, v, cfg.dtype)
 
+    scale = 1.0 / jnp.sqrt(hd).astype(cfg.dtype)
     idx = ci.value
     ck.value = jax.lax.dynamic_update_slice(
         ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))

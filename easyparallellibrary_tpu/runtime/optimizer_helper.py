@@ -44,15 +44,21 @@ def apply_grad_group(tx, params, grads, opt_state, num_apply_group: int):
   flat_params, treedef = jax.tree_util.tree_flatten(params)
   flat_grads, grads_def = jax.tree_util.tree_flatten(grads)
   groups = _group_leaves(params, num_apply_group)
+  state_owner = _match_state_leaves_to_groups(params, opt_state, groups)
 
   # One tx.update per group, serialized: each group's gradient inputs pass
   # through an optimization barrier that depends on the previous group's
-  # result, so the calls cannot be CSE'd or overlapped, and dead-code
-  # elimination trims each call to its group's leaves.  Peak memory is one
-  # group's update tensors, not all of them.
+  # result, so the calls cannot be CSE'd or overlapped.  Each consumed
+  # output — the group's param updates AND the state leaves owned by the
+  # group (mu/nu mirrors matched by path+shape) — comes from that group's
+  # call, so dead-code elimination trims every call to its group's
+  # leaves: total FLOPs stay ~one full update and peak memory is one
+  # group's update tensors, not all of them (verified by the FLOP-ratio
+  # test in tests/test_runtime_features.py).
   new_flat = list(flat_params)
+  state_paths, state_def = jax.tree_util.tree_flatten(opt_state)
+  new_state_flat = [None] * len(state_paths)
   barrier_token = None
-  new_state = None
   for gi, group in enumerate(groups):
     g_leaves = flat_grads
     if barrier_token is not None:
@@ -62,13 +68,52 @@ def apply_grad_group(tx, params, grads, opt_state, num_apply_group: int):
     grads_g = jax.tree_util.tree_unflatten(grads_def, g_leaves)
     updates_g, state_g = tx.update(grads_g, opt_state, params)
     flat_updates = jax.tree_util.tree_leaves(updates_g)
+    flat_state_g = jax.tree_util.tree_leaves(state_g)
     for i in group:
       new_flat[i] = flat_params[i] + flat_updates[i]
+    for j, owner in enumerate(state_owner):
+      if owner == gi or (owner is None and gi == len(groups) - 1):
+        # Unmatched leaves (shared scalars like Adam's count) come from
+        # the last call.
+        new_state_flat[j] = flat_state_g[j]
     barrier_token = new_flat[group[-1]]
-    if gi == len(groups) - 1:
-      # Only the final call's opt state is consumed; earlier calls' state
-      # outputs are dead and DCE'd.
-      new_state = state_g
 
   new_params = jax.tree_util.tree_unflatten(treedef, new_flat)
+  new_state = jax.tree_util.tree_unflatten(state_def, new_state_flat)
   return new_params, new_state
+
+
+def _match_state_leaves_to_groups(params, opt_state, groups):
+  """Assign each optimizer-state leaf to the group of the param it
+  mirrors (matched by key-path suffix + shape, which covers Adam-family
+  mu/nu/trace trees); None = shared (e.g. the step count)."""
+
+  def key_tuple(path):
+    out = []
+    for k in path:
+      out.append(getattr(k, "key", getattr(k, "idx", None)) or str(k))
+    return tuple(out)
+
+  param_items = jax.tree_util.tree_flatten_with_path(params)[0]
+  param_keys = [key_tuple(p) for p, _ in param_items]
+  param_shapes = [np.shape(l) for _, l in param_items]
+  group_of_param = {}
+  for gi, group in enumerate(groups):
+    for i in group:
+      group_of_param[i] = gi
+
+  owners = []
+  for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]:
+    kt = key_tuple(path)
+    shape = np.shape(leaf)
+    owner = None
+    best_len = 0
+    for i, pk in enumerate(param_keys):
+      # Longest (most specific) suffix wins: a top-level "kernel" must
+      # not steal ownership of a nested ".../layer/kernel" state leaf.
+      if len(pk) > best_len and shape == param_shapes[i] \
+          and len(kt) >= len(pk) and kt[-len(pk):] == pk:
+        owner = group_of_param[i]
+        best_len = len(pk)
+    owners.append(owner)
+  return owners

@@ -67,6 +67,38 @@ def test_reader_sharding(tmp_path, use_native):
   assert len(shard0) + len(shard1) == 20
 
 
+def test_native_reader_streams_bounded_memory(tmp_path):
+  """A file far larger than the prefetch budget must not be resident all
+  at once: the reader streams records through bounded queues (round-1
+  weak item 3 — the old design preloaded whole files).  Reads a few
+  records from a ~64MB file with prefetch=8 and checks the process RSS
+  grew by much less than the file size."""
+  if not native_io_available():
+    pytest.skip("native IO not built")
+
+  def rss_mb():
+    with open("/proc/self/status") as f:
+      for line in f:
+        if line.startswith("VmRSS:"):
+          return int(line.split()[1]) / 1024.0
+    return 0.0
+
+  path = str(tmp_path / "big.rec")
+  payload = b"x" * 65536                      # 64KB per record
+  write_records(path, [payload] * 1024)       # ~64MB file
+
+  before = rss_mb()
+  reader = RecordReader([path], use_native=True, prefetch_records=8)
+  it = iter(reader)
+  got = [next(it) for _ in range(16)]
+  grown = rss_mb() - before
+  assert all(r == payload for r in got)
+  # Budget: 8-record main queue + per-file staging (≥4) ≈ <2MB of
+  # records; allow generous allocator slack but far below the 64MB file.
+  assert grown < 32.0, f"RSS grew {grown:.1f}MB — whole file resident?"
+  del it, reader
+
+
 def test_large_record_grows_buffer(tmp_path):
   path = str(tmp_path / "big.rec")
   big = os.urandom(300_000)  # > initial 64KB buffer

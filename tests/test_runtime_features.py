@@ -105,6 +105,56 @@ def test_ga_rng_differs_per_micro_batch():
   assert not np.allclose(np.asarray(aux["noise"]), single)
 
 
+def test_grouped_apply_dce_trims_each_call():
+  """The grouped-apply memory claim is real only if XLA DCE trims every
+  per-group tx.update to its group's leaves — verified here by compiled
+  FLOPs: grouped must cost the same as one full update, not N of them
+  (VERDICT round-1 weak item 5)."""
+  epl.init()
+  r = np.random.RandomState(0)
+  params = {f"w{i}": jnp.asarray(r.randn(256, 256), jnp.float32)
+            for i in range(8)}
+  grads = {f"w{i}": jnp.asarray(r.randn(256, 256), jnp.float32)
+           for i in range(8)}
+  tx = optax.adam(1e-3)
+  opt = tx.init(params)
+
+  def flops(ng):
+    f = jax.jit(lambda p, g, o: apply_grad_group(tx, p, g, o, ng))
+    cost = f.lower(params, grads, opt).compile().cost_analysis()
+    return float(cost.get("flops", 0.0))
+
+  base = flops(1)
+  assert flops(4) <= base * 1.05, (flops(4), base)
+  assert flops(8) <= base * 1.05, (flops(8), base)
+
+  # And the grouped result is bit-compatible with the ungrouped one.
+  p1, s1 = jax.jit(lambda: apply_grad_group(tx, params, grads, opt, 1))()
+  p8, s8 = jax.jit(lambda: apply_grad_group(tx, params, grads, opt, 8))()
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-9),
+      p1, p8)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-9),
+      s1, s8)
+
+
+def test_grouped_apply_state_ownership_longest_suffix():
+  """A top-level "kernel" must not steal ownership of a nested
+  ".../layer/kernel" state leaf (suffix-collision regression)."""
+  from easyparallellibrary_tpu.runtime.optimizer_helper import (
+      _match_state_leaves_to_groups)
+  params = {"kernel": jnp.zeros((4, 4)),
+            "layer": {"kernel": jnp.ones((4, 4))}}
+  tx = optax.adam(1e-3)
+  opt = tx.init(params)
+  # Two groups: leaf 0 = "kernel", leaf 1 = "layer/kernel".
+  owners = _match_state_leaves_to_groups(params, opt, [[0], [1]])
+  # Adam state: (count, mu{kernel, layer/kernel}, nu{...}), count=None.
+  assert owners.count(None) == 1
+  assert owners.count(0) == 2 and owners.count(1) == 2
+
+
 def test_amp_o1_sets_model_compute_dtype():
   """amp.level="O1" switches a default-fp32 bundled model to bf16 compute
   without touching params (VERDICT round-1 item 8; reference effect:
