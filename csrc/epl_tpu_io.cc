@@ -155,10 +155,19 @@ class Reader {
     size_t n = files_.size();
     std::atomic<size_t> next_file{0};
 
-    auto worker = [&] {
+    size_t workers_n = std::min<size_t>(num_threads_, n ? n : 1);
+    auto worker = [&, workers_n] {
       for (;;) {
         size_t i = next_file.fetch_add(1);
         if (i >= n || stop_.load()) return;
+        // Stay within a bounded window of the in-order producer cursor;
+        // otherwise many-small-file datasets would be staged wholesale
+        // (memory O(num_files * per_file_cap)) while the producer is
+        // still on file 0.
+        while (i >= producer_pos_.load() + workers_n && !stop_.load()) {
+          std::this_thread::sleep_for(std::chrono::microseconds(100));
+        }
+        if (stop_.load()) return;
         FILE* f = std::fopen(files_[i].c_str(), "rb");
         if (f) {
           for (;;) {
@@ -176,11 +185,11 @@ class Reader {
       }
     };
     std::vector<std::thread> pool;
-    size_t workers = std::min<size_t>(num_threads_, n ? n : 1);
-    pool.reserve(workers);
-    for (size_t t = 0; t < workers; ++t) pool.emplace_back(worker);
+    pool.reserve(workers_n);
+    for (size_t t = 0; t < workers_n; ++t) pool.emplace_back(worker);
 
     for (size_t i = 0; i < n && !stop_.load(); ++i) {
+      producer_pos_.store(i);
       for (;;) {
         Record r;
         if (!file_queues_[i]->pop(&r) || r.eof) break;
@@ -199,6 +208,7 @@ class Reader {
   size_t per_file_cap_ = 4;
   std::vector<std::unique_ptr<BoundedQueue>> file_queues_;
   std::thread producer_;
+  std::atomic<size_t> producer_pos_{0};
   std::atomic<bool> stop_{false};
   Record pending_;
   bool pending_valid_ = false;

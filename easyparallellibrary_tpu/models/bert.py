@@ -204,9 +204,9 @@ def bert_qa_loss(model: BertForQuestionAnswering, params, batch, rng=None):
   start_logits, end_logits = model.apply({"params": params}, batch["ids"])
   loss = (
       distributed_sparse_softmax_cross_entropy_with_logits(
-          batch["start_positions"], start_logits.astype(jnp.float32))
+          batch["start_positions"], start_logits)
       + distributed_sparse_softmax_cross_entropy_with_logits(
-          batch["end_positions"], end_logits.astype(jnp.float32)))
+          batch["end_positions"], end_logits))
   return jnp.mean(loss) / 2, {}
 
 
@@ -215,7 +215,7 @@ def bert_mlm_loss(model: Bert, params, batch, rng=None):
   "mask": [B,S] float (1 where a token is masked/predicted)}."""
   logits = model.apply({"params": params}, batch["ids"])
   loss = distributed_sparse_softmax_cross_entropy_with_logits(
-      batch["labels"], logits.astype(jnp.float32))
+      batch["labels"], logits)
   mask = batch["mask"].astype(jnp.float32)
   total = jnp.sum(loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
   return total, {}

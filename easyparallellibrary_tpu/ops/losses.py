@@ -37,24 +37,38 @@ def distributed_sparse_softmax_cross_entropy_with_logits(
   """Cross entropy over (possibly vocab-sharded) logits.
 
   labels: int array [...]; logits: [..., vocab].  Returns per-example loss
-  with the same leading shape as `labels`.
+  (float32) with the same leading shape as `labels`.
+
+  Pass logits in their COMPUTE dtype (bf16): the softmax math runs in
+  fp32 via casts *inside* the fused reductions, so no fp32 [..., vocab]
+  copy — and, in the backward, no fp32 cotangent — ever materializes in
+  HBM; at GPT-350M bench shape that copy is the single largest tensor
+  (round-1 NOTES bottleneck).  The fp32 upcast of bf16 values is exact,
+  so this loses nothing over casting before the call.
 
   `z_loss` adds the auxiliary log-normalizer penalty (stabilizes large
   sharded softmaxes; not in the reference, standard for TPU LLM training).
   """
   logits = _vocab_sharded(logits)
   # Stable shift (reference: allgather per-shard max -> global max, :58-80).
+  # max is an order statistic — exact in the storage dtype.
   m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
-  shifted = logits - m
-  # Global normalizer (reference: allreduce of per-shard sums, :81-100).
-  sum_exp = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
-  log_z = jnp.log(sum_exp)
-  # Pick out the label logit (reference: one-hot mask over the local label
-  # range + allreduce, :101-152); take_along_axis partitions cleanly.
+  m32 = m.astype(jnp.float32)
+  # Global normalizer in fp32 (reference: allreduce of per-shard sums,
+  # :81-100).  The fp32 `shifted` has exactly one consumer — the
+  # exp+reduce — so XLA fuses the cast and subtraction into the reduction
+  # and no fp32 [..., vocab] tensor materializes; the math matches the
+  # old cast-before-the-call path to reduction order.
+  sum_exp = jnp.sum(jnp.exp(logits.astype(jnp.float32) - m32), axis=-1,
+                    keepdims=True)
+  total_log_z = jnp.log(sum_exp) + m32          # log Z in fp32
+  # Pick out the label logit from the UNSHIFTED logits (their stored
+  # values upcast exactly; subtracting m in bf16 first would round it)
+  # (reference: one-hot mask over the local label range + allreduce,
+  # :101-152); take_along_axis partitions cleanly.
   label_logit = jnp.take_along_axis(
-      shifted, labels[..., None].astype(jnp.int32), axis=-1)
-  loss = (log_z - label_logit)[..., 0]
+      logits, labels[..., None].astype(jnp.int32), axis=-1)
+  loss = (total_log_z - label_logit.astype(jnp.float32))[..., 0]
   if z_loss:
-    total_log_z = (log_z + m)[..., 0]
-    loss = loss + z_loss * jnp.square(total_log_z)
+    loss = loss + z_loss * jnp.square(total_log_z[..., 0])
   return loss

@@ -158,6 +158,44 @@ def test_generate_greedy_and_sampled():
     generate(model, params, jnp.zeros((1, 15), jnp.int32), 10)  # > max_seq
 
 
+def test_chunked_ce_matches_full_loss():
+  """loss_chunk computes the identical loss/grads without materializing
+  the [B, S, vocab] logits (round-1 NOTES bottleneck: vocab-32k head)."""
+  from easyparallellibrary_tpu.models.gpt import gpt_loss
+  base = dict(vocab_size=128, num_layers=2, num_heads=4, d_model=32,
+              d_ff=64, max_seq_len=16, dtype=jnp.float32)
+  full = GPT(GPTConfig(**base))
+  chunked = GPT(GPTConfig(**base, loss_chunk=4))
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (4, 17)),
+                    jnp.int32)
+  params = full.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+
+  l_full, g_full = jax.jit(jax.value_and_grad(
+      lambda p: gpt_loss(full, p, {"ids": ids})[0]))(params)
+  l_chunk, g_chunk = jax.jit(jax.value_and_grad(
+      lambda p: gpt_loss(chunked, p, {"ids": ids})[0]))(params)
+  np.testing.assert_allclose(float(l_full), float(l_chunk), rtol=1e-6)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+      g_full, g_chunk)
+
+  # The peak-memory reason to exist: grad-step temp bytes shrink.
+  big = GPTConfig(**{**base, "vocab_size": 4096, "max_seq_len": 64})
+  big_ids = jnp.asarray(np.random.RandomState(1).randint(0, 4096, (4, 65)),
+                        jnp.int32)
+  pf = GPT(big)
+  params_big = pf.init(jax.random.PRNGKey(0), big_ids[:, :-1])["params"]
+
+  def temp_of(cfg):
+    m = GPT(cfg)
+    f = jax.jit(jax.grad(lambda p: gpt_loss(m, p, {"ids": big_ids})[0]))
+    return f.lower(params_big).compile().memory_analysis().temp_size_in_bytes
+
+  t_full = temp_of(big)
+  t_chunk = temp_of(GPTConfig(**{**big.__dict__, "loss_chunk": 8}))
+  assert t_chunk < t_full, (t_chunk, t_full)
+
+
 def test_generate_kv_cache_matches_full_forward():
   """The O(1)-per-token cached decode reproduces the full-forward path
   exactly (greedy and sampled) — VERDICT round-1 item 10."""
