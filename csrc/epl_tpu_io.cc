@@ -113,6 +113,10 @@ class Reader {
 
   ~Reader() {
     stop_.store(true);
+    // Pair the notify with the lock so a worker can't check stop_ just
+    // before the store and then sleep through the wakeup.
+    { std::lock_guard<std::mutex> lk(pos_mu_); }
+    pos_cv_.notify_all();
     for (auto& q : file_queues_) q->close();
     queue_.close();
     if (producer_.joinable()) producer_.join();
@@ -163,9 +167,13 @@ class Reader {
         // Stay within a bounded window of the in-order producer cursor;
         // otherwise many-small-file datasets would be staged wholesale
         // (memory O(num_files * per_file_cap)) while the producer is
-        // still on file 0.
-        while (i >= producer_pos_.load() + workers_n && !stop_.load()) {
-          std::this_thread::sleep_for(std::chrono::microseconds(100));
+        // still on file 0.  Condvar wait: blocked workers sleep until
+        // the cursor actually advances instead of burning CPU polling.
+        {
+          std::unique_lock<std::mutex> lk(pos_mu_);
+          pos_cv_.wait(lk, [&] {
+            return i < producer_pos_.load() + workers_n || stop_.load();
+          });
         }
         if (stop_.load()) return;
         FILE* f = std::fopen(files_[i].c_str(), "rb");
@@ -189,7 +197,11 @@ class Reader {
     for (size_t t = 0; t < workers_n; ++t) pool.emplace_back(worker);
 
     for (size_t i = 0; i < n && !stop_.load(); ++i) {
-      producer_pos_.store(i);
+      {
+        std::lock_guard<std::mutex> lk(pos_mu_);
+        producer_pos_.store(i);
+      }
+      pos_cv_.notify_all();
       for (;;) {
         Record r;
         if (!file_queues_[i]->pop(&r) || r.eof) break;
@@ -209,6 +221,8 @@ class Reader {
   std::vector<std::unique_ptr<BoundedQueue>> file_queues_;
   std::thread producer_;
   std::atomic<size_t> producer_pos_{0};
+  std::mutex pos_mu_;
+  std::condition_variable pos_cv_;
   std::atomic<bool> stop_{false};
   Record pending_;
   bool pending_valid_ = false;

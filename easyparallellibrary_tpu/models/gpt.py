@@ -301,6 +301,15 @@ def _remat_policy(name: str):
   return None
 
 
+def _tied_embedding(cfg: GPTConfig, name=None) -> Embedding:
+  """Token-embedding construction shared by the forward pass, the chunked
+  tied-head CE, and the 1F1B emit head — one site so the tied table's
+  sharding/init can never silently diverge between them."""
+  return Embedding(cfg.vocab_size, cfg.d_model,
+                   parallel="vocab" if cfg.tensor_parallel else "none",
+                   param_dtype=cfg.param_dtype, name=name)
+
+
 class GPT(nn.Module):
   """Decoder-only LM.  `__call__(ids) -> logits`; `loss(params-free)` via
   :func:`gpt_loss`."""
@@ -316,9 +325,7 @@ class GPT(nn.Module):
     if decode and cfg.pipeline_stages > 1:
       raise ValueError("KV-cache decode is single-program; run generation "
                        "on a non-pipelined config (pipeline_stages=1)")
-    tok = Embedding(cfg.vocab_size, cfg.d_model,
-                    parallel="vocab" if cfg.tensor_parallel else "none",
-                    param_dtype=cfg.param_dtype, name="wte")
+    tok = _tied_embedding(cfg, name="wte")
     pos_init = nn.initializers.normal(stddev=0.02)
     pos = self.param("wpe", nn.with_partitioning(pos_init, (None, None)), (cfg.max_seq_len, cfg.d_model),
                      cfg.param_dtype)
@@ -412,9 +419,7 @@ def _chunked_tied_ce(model: GPT, params, hidden, targets):
   B, S = targets.shape
   if S % C != 0:
     raise ValueError(f"loss_chunk={C} must divide sequence length {S}")
-  emb = Embedding(cfg.vocab_size, cfg.d_model,
-                  parallel="vocab" if cfg.tensor_parallel else "none",
-                  param_dtype=cfg.param_dtype)
+  emb = _tied_embedding(cfg)
   wte = nn.meta.unbox(params)["wte"]
 
   def chunk_loss(h, t):
@@ -515,9 +520,7 @@ def make_gpt_1f1b_grad_fn(model: GPT):
         f"num_layers={cfg.num_layers} must divide evenly into {S} stages "
         f"when MoE is enabled (sown aux losses cannot be masked per stage)")
 
-  emb = Embedding(cfg.vocab_size, cfg.d_model,
-                  parallel="vocab" if cfg.tensor_parallel else "none",
-                  param_dtype=cfg.param_dtype)
+  emb = _tied_embedding(cfg)
   ln_f = LayerNorm(dtype=cfg.dtype)
   head = None
   if not cfg.tie_embeddings:

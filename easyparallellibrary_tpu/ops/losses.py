@@ -54,21 +54,29 @@ def distributed_sparse_softmax_cross_entropy_with_logits(
   # max is an order statistic — exact in the storage dtype.
   m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
   m32 = m.astype(jnp.float32)
+  # Single fp32 view of the logits feeding BOTH the normalizer and the
+  # label pick.  This matters for the backward, not just the forward: the
+  # two cotangent contributions (softmax probabilities and the scattered
+  # -1 at the label) then accumulate in fp32 and round to the storage
+  # dtype once, so the label-position gradient p-1 survives even when
+  # bf16(p) == 1 (confident predictions).  Taking the label logit from
+  # the bf16 array instead would round each contribution separately and
+  # cancel to exactly zero.  The cast is cheap elementwise work XLA
+  # duplicates into each consumer fusion; no fp32 [..., vocab] copy is
+  # materialized in HBM (verified via compiled memory_analysis at bench
+  # shape).
+  logits32 = logits.astype(jnp.float32)
   # Global normalizer in fp32 (reference: allreduce of per-shard sums,
-  # :81-100).  The fp32 `shifted` has exactly one consumer — the
-  # exp+reduce — so XLA fuses the cast and subtraction into the reduction
-  # and no fp32 [..., vocab] tensor materializes; the math matches the
-  # old cast-before-the-call path to reduction order.
-  sum_exp = jnp.sum(jnp.exp(logits.astype(jnp.float32) - m32), axis=-1,
-                    keepdims=True)
+  # :81-100); the subtraction and exp fuse into the reduction.
+  sum_exp = jnp.sum(jnp.exp(logits32 - m32), axis=-1, keepdims=True)
   total_log_z = jnp.log(sum_exp) + m32          # log Z in fp32
   # Pick out the label logit from the UNSHIFTED logits (their stored
   # values upcast exactly; subtracting m in bf16 first would round it)
   # (reference: one-hot mask over the local label range + allreduce,
   # :101-152); take_along_axis partitions cleanly.
   label_logit = jnp.take_along_axis(
-      logits, labels[..., None].astype(jnp.int32), axis=-1)
-  loss = (total_log_z - label_logit.astype(jnp.float32))[..., 0]
+      logits32, labels[..., None].astype(jnp.int32), axis=-1)
+  loss = (total_log_z - label_logit)[..., 0]
   if z_loss:
     loss = loss + z_loss * jnp.square(total_log_z[..., 0])
   return loss

@@ -113,6 +113,28 @@ def test_sharded_ce_matches_optax():
   np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-6)
 
 
+def test_bf16_ce_label_grad_survives_confident_prediction():
+  """The fused CE accepts bf16 logits; the label-position gradient is
+  p - 1, which must be computed in fp32 *before* rounding to bf16.  If
+  the softmax cotangent and the scattered -1 were each rounded to bf16
+  separately, they'd cancel to exactly 0 whenever bf16(p) == 1 (any
+  confidently-predicted token) — silently zeroing the training signal."""
+  logits = jnp.asarray([[10.0, 0.0, 0.0, 0.0]], jnp.bfloat16)
+  labels = jnp.asarray([0], jnp.int32)
+
+  def f(lg):
+    return jnp.sum(ops.distributed_sparse_softmax_cross_entropy_with_logits(
+        labels, lg))
+
+  g = jax.grad(f)(logits)
+  # fp32 reference: p - 1 at the label position.
+  p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)[0, 0]
+  expected = float(p - 1.0)
+  got = float(g[0, 0])
+  assert got != 0.0, "label gradient cancelled to zero in bf16"
+  np.testing.assert_allclose(got, expected, rtol=0.02)
+
+
 def test_uneven_features_pad_and_match():
   """Uneven tensor-parallel dims (the reference's remainder case) are
   zero-padded to even tiles and sliced back; numerics match unsharded."""
