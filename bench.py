@@ -102,13 +102,18 @@ def main():
     # kernel outputs so the backward never re-runs the forward kernel.
     # Together these take the fit batch from 8 to 16 and MFU from ~0.44
     # to ~0.49 on the v5e chip.
+    attn = os.environ.get("EPL_BENCH_ATTN", "pallas_flash")
+    remat_policy = os.environ.get("EPL_BENCH_REMAT", "dots_flash")
+    # A typo here must fail loudly, not silently measure a different
+    # configuration than the label claims.
+    if attn not in ("xla", "pallas_flash"):
+      raise ValueError(f"EPL_BENCH_ATTN must be xla|pallas_flash: {attn}")
+    if remat_policy not in ("nothing", "dots", "dots_flash", "everything"):
+      raise ValueError(f"EPL_BENCH_REMAT invalid: {remat_policy}")
     cfg = GPTConfig(vocab_size=32768, num_layers=24, num_heads=16,
                     d_model=1024, d_ff=4096, max_seq_len=1024,
                     dtype=jnp.bfloat16, remat=True,
-                    attn_impl=os.environ.get("EPL_BENCH_ATTN",
-                                             "pallas_flash"),
-                    remat_policy=os.environ.get("EPL_BENCH_REMAT",
-                                                "dots_flash"),
+                    attn_impl=attn, remat_policy=remat_policy,
                     loss_chunk=int(os.environ.get("EPL_BENCH_LOSS_CHUNK",
                                                   "256")))
     batch_candidates = [int(b) for b in os.environ.get(

@@ -1,0 +1,61 @@
+"""Shared helpers for the attention benchmarks.
+
+The timing recipe exists because of the remote-relay TPU backend:
+``block_until_ready`` returns before execution (including compile)
+finishes there, so warmup and timing must force completion by fetching
+a scalar that depends on the result, and subtract a measured null
+round-trip (bench.py does the same for the headline number).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def force(x):
+  """Force execution of `x` and everything it depends on."""
+  return float(jax.device_get(jnp.sum(x) if hasattr(x, "shape") else x))
+
+
+def null_round_trip():
+  tiny = jax.jit(lambda v: v + 1)
+  force(tiny(jnp.float32(0)))
+  t0 = time.perf_counter()
+  force(tiny(jnp.float32(1)))
+  return time.perf_counter() - t0
+
+
+def xla_attention(q, k, v):
+  """The models' XLA attention path (models/gpt.py attend): bf16
+  einsums, fp32 softmax, causal."""
+  d = q.shape[-1]
+  s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+  S = q.shape[1]
+  mask = jnp.tril(jnp.ones((S, S), bool))
+  s = jnp.where(mask, s, -1e30)
+  p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+  return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def time_attn_grad(attn, q, k, v, steps=20):
+  """Milliseconds per fused fwd+bwd step of `attn`, chained through q so
+  the whole sequence must execute."""
+  g = jax.jit(jax.grad(lambda *a: jnp.sum(attn(*a) ** 2)))
+  out = g(q, k, v)
+  force(out[0, 0, 0])
+  null = null_round_trip()
+  t0 = time.perf_counter()
+  acc = q
+  for _ in range(steps):
+    acc = g(acc, k, v)
+  force(acc[0, 0, 0])
+  return max(time.perf_counter() - t0 - null, 1e-9) / steps * 1000

@@ -2,59 +2,23 @@
 
 Run on the real chip: `python benchmarks/flash_vs_xla.py [S ...]`.
 Times a full grad step through the attention op at GPT-350M bench shape
-(B=8, H=16, D=64) for each sequence length, using the chained-steps +
-device_get timing recipe from bench.py (the relay backend returns from
-block_until_ready early).
+(B=8, H=16, D=64) for each sequence length.
 """
 
 from __future__ import annotations
 
 import os
 import sys
-import time
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
-from easyparallellibrary_tpu.kernels.flash_attention import flash_attention
-
-
-def xla_attention(q, k, v):
-  # The models' XLA path (models/gpt.py attend): bf16 einsums, fp32 softmax.
-  d = q.shape[-1]
-  s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
-  S = q.shape[1]
-  mask = jnp.tril(jnp.ones((S, S), bool))
-  s = jnp.where(mask, s, -1e30)
-  p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
-  return jnp.einsum("bhqk,bkhd->bqhd", p, v)
-
-
-def timeit(fn, args, steps=20):
-  loss = jax.jit(lambda *a: jnp.sum(fn(*a) ** 2))
-  g = jax.jit(jax.grad(loss))
-  # device_get, not block_until_ready: the relay backend returns from
-  # block_until_ready before execution (incl. compile) actually finishes.
-  out = g(*args)
-  float(jax.device_get(jnp.sum(out[0, 0, 0])))
-  # null round trip
-  tiny = jax.jit(lambda v: v + 1)
-  float(jax.device_get(tiny(jnp.float32(0))))
-  t0 = time.perf_counter()
-  float(jax.device_get(tiny(jnp.float32(1))))
-  null_rt = time.perf_counter() - t0
-
-  t0 = time.perf_counter()
-  acc = args[0]
-  for _ in range(steps):
-    acc = g(acc, *args[1:])
-  float(jax.device_get(jnp.sum(acc[0, 0, 0])))
-  dt = max(time.perf_counter() - t0 - null_rt, 1e-9)
-  return dt / steps * 1000  # ms
+from benchmarks._common import time_attn_grad, xla_attention  # noqa: E402
+from easyparallellibrary_tpu.kernels.flash_attention import (  # noqa: E402
+    flash_attention)
 
 
 def main():
@@ -65,10 +29,10 @@ def main():
     q = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
     k = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
     v = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
-    flash_ms = timeit(lambda a, b, c: flash_attention(a, b, c, causal=True),
-                      (q, k, v))
+    flash_ms = time_attn_grad(
+        lambda a, b, c: flash_attention(a, b, c, causal=True), q, k, v)
     try:
-      xla_ms = timeit(xla_attention, (q, k, v))
+      xla_ms = time_attn_grad(xla_attention, q, k, v)
     except Exception as e:  # XLA full attention OOMs at long S
       xla_ms = float("nan")
       print(f"S={S}: XLA failed ({type(e).__name__})")

@@ -1,0 +1,112 @@
+"""Long-context evidence at the sequence-parallel design point.
+
+Measures, on the real chip, the thing ring/blockwise attention exists
+for: attention cost and trainability as S grows past what full
+(materialized-scores) attention can hold.
+
+  python benchmarks/long_context.py            # S = 4096 8192 16384
+  python benchmarks/long_context.py 8192 32768 # explicit lengths
+
+Per S prints: flash-attention grad-step time, XLA full-attention grad
+time (or OOM), and a GPT-125M-deep train step at that length with
+pallas_flash + dots_flash remat (tokens/sec + achieved MFU).
+
+The multi-device ring path itself (shard_map + ppermute + the same flash
+kernel per block) is validated functionally on the 8-device CPU mesh by
+tests/test_sequence_parallel.py; a single chip exercises its compute
+kernel and the blockwise memory behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from benchmarks._common import (force, null_round_trip,  # noqa: E402
+                                time_attn_grad, xla_attention)
+from bench import peak_flops_per_chip  # noqa: E402
+from easyparallellibrary_tpu.kernels.flash_attention import (  # noqa: E402
+    flash_attention)
+
+
+def gpt_long_train(S, steps=5):
+  import optax
+  import easyparallellibrary_tpu as epl
+  from easyparallellibrary_tpu.models import GPT, GPTConfig
+  from easyparallellibrary_tpu.models.gpt import (gpt_flops_per_token,
+                                                  gpt_loss)
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state, make_train_step, parallelize)
+  cfg = GPTConfig(vocab_size=32768, num_layers=12, num_heads=12,
+                  d_model=768, d_ff=3072, max_seq_len=S,
+                  dtype=jnp.bfloat16, remat=True,
+                  remat_policy="dots_flash", attn_impl="pallas_flash",
+                  loss_chunk=512)
+  epl.init()
+  with epl.replicate(1):
+    model = GPT(cfg)
+  mesh = epl.current_plan().build_mesh()
+  B = 1
+  ids = jnp.asarray(np.random.RandomState(0).randint(
+      0, cfg.vocab_size, (B, S + 1)), jnp.int32)
+  batch = {"ids": ids}
+  rng = jax.random.PRNGKey(0)
+  tx = optax.adamw(3e-4)
+
+  def init_fn(r):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(r, ids[:, :-1])["params"],
+                             tx=tx)
+
+  state, sh = create_sharded_train_state(init_fn, mesh, rng)
+  step = parallelize(make_train_step(lambda p, b, r: gpt_loss(model, p, b,
+                                                              r)),
+                     mesh, sh)
+  state, m = step(state, batch, rng)
+  force(m["loss"])
+  null = null_round_trip()
+  t0 = time.perf_counter()
+  for _ in range(steps):
+    state, m = step(state, batch, rng)
+  force(m["loss"])
+  dt = (time.perf_counter() - t0 - null) / steps
+  tps = B * S / dt
+  mfu = tps * gpt_flops_per_token(cfg, S) / peak_flops_per_chip()
+  return dt * 1000, tps, mfu
+
+
+def main():
+  seqs = [int(s) for s in sys.argv[1:]] or [4096, 8192, 16384]
+  B, H, D = 1, 16, 64
+  for S in seqs:
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(B, S, H, D), jnp.bfloat16)
+    k = jnp.asarray(r.randn(B, S, H, D), jnp.bfloat16)
+    v = jnp.asarray(r.randn(B, S, H, D), jnp.bfloat16)
+    flash_ms = time_attn_grad(
+        lambda a, b, c: flash_attention(a, b, c, causal=True), q, k, v,
+        steps=10)
+    try:
+      xla_ms = f"{time_attn_grad(xla_attention, q, k, v, steps=10):.1f} ms"
+    except Exception as e:
+      xla_ms = f"OOM/fail ({type(e).__name__})"
+    print(f"S={S}: attention grad flash {flash_ms:.1f} ms, "
+          f"xla {xla_ms}", flush=True)
+    try:
+      ms, tps, mfu = gpt_long_train(S)
+      print(f"S={S}: GPT-125M(12L/768d) train step {ms:.0f} ms, "
+            f"{tps:.0f} tok/s, MFU {mfu:.3f}", flush=True)
+    except Exception as e:
+      print(f"S={S}: GPT train failed ({type(e).__name__})", flush=True)
+
+
+if __name__ == "__main__":
+  main()

@@ -254,6 +254,12 @@ class SequenceConfig(_Category):
       # Block size for blockwise/ring attention; 0 = one block per
       # seq-axis device (finer blocking is opt-in).
       "block_size": 0,
+      # "flash" (default): shard_map ring with the Pallas flash kernel
+      # per block and a KV-recommunicating backward — O(S/n) live memory
+      # per device.  "einsum": global-array formulation (GSPMD-
+      # composable; used automatically when num_blocks/block_size asks
+      # for finer-than-device blocking).
+      "ring_impl": "flash",
   }
 
 
@@ -338,6 +344,9 @@ class Config:
         "", constants.SEQ_PARALLEL_RING, constants.SEQ_PARALLEL_ULYSSES):
       raise ValueError("sequence.parallelism must be '', 'ring' or "
                        f"'ulysses'; got {self.sequence.parallelism!r}")
+    if self.sequence.ring_impl not in ("flash", "einsum"):
+      raise ValueError("sequence.ring_impl must be 'flash' or 'einsum'; "
+                       f"got {self.sequence.ring_impl!r}")
     if self.pipeline.num_micro_batch < 1:
       raise ValueError("pipeline.num_micro_batch must be >= 1")
     if self.pipeline.num_stages < 1:

@@ -204,18 +204,22 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
   dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd(causal, block_q, block_k, residuals, dout):
-  q, k, v, out, lse = residuals
+def _tile8(x):
+  """Broadcast a [B, H, S] row across 8 sublanes -> [B, H, 8, S] (the
+  TPU-tiled layout the backward kernels read lse/delta in)."""
+  B, H, S = x.shape
+  return jnp.broadcast_to(x[:, :, None, :], (B, H, 8, S)).copy()
+
+
+def _bwd_kernels(q, k, v, dout, lse8, delta8, causal, block_q, block_k):
+  """The two backward pallas calls with caller-supplied (lse, delta)
+  tiles.  Shared by the plain flash vjp (per-call lse, delta from
+  rowsum(dO*O) - dlse) and the ring-attention backward (GLOBAL lse over
+  all ring blocks, delta from the merged output)."""
   B, H, S, D = q.shape
   bq = min(block_q, S)
   bk = min(block_k, S)
   scale = 1.0 / np.sqrt(D)
-  # delta = rowsum(dO * O) — cheap elementwise, plain XLA.  Broadcast
-  # across 8 sublanes to match the lse tiling layout.
-  delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
-                  axis=-1)                                 # [B, H, S]
-  delta = jnp.broadcast_to(delta[:, :, None, :],
-                           (B, H, 8, S)).copy()            # [B, H, 8, S]
 
   dk, dv = pl.pallas_call(
       functools.partial(_bwd_dkv_kernel, block_q=bq, causal=causal,
@@ -238,7 +242,7 @@ def _bwd(causal, block_q, block_k, residuals, dout):
           jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
       ],
       interpret=_interpret(),
-  )(q, k, v, dout, lse, delta)
+  )(q, k, v, dout, lse8, delta8)
 
   dq = pl.pallas_call(
       functools.partial(_bwd_dq_kernel, block_k=bk, causal=causal,
@@ -255,8 +259,21 @@ def _bwd(causal, block_q, block_k, residuals, dout):
       out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
       out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
       interpret=_interpret(),
-  )(q, k, v, dout, lse, delta)
+  )(q, k, v, dout, lse8, delta8)
   return dq, dk, dv
+
+
+def _bwd(causal, block_q, block_k, residuals, dout, dlse=None):
+  q, k, v, out, lse = residuals
+  # delta = rowsum(dO * O) — cheap elementwise, plain XLA.  An lse
+  # cotangent folds in here: d lse_i/d s_ij = p_ij, so
+  # ds = p*(dp - delta + dlse) == p*(dp - (delta - dlse)).
+  delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                  axis=-1)                                 # [B, H, S]
+  if dlse is not None:
+    delta = delta - dlse.astype(jnp.float32)
+  return _bwd_kernels(q, k, v, dout, lse, _tile8(delta), causal,
+                      block_q, block_k)
 
 
 # ------------------------------------------------------------ public API --
@@ -285,6 +302,50 @@ def _flash_bwd(causal, block_q, block_k, residuals, dout):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_lse(q, k, v, causal, block_q, block_k):
+  out, lse8 = _fwd(q, k, v, causal, block_q, block_k)
+  return out, lse8[:, :, 0, :]
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k):
+  out, lse8 = _fwd(q, k, v, causal, block_q, block_k)
+  # Same remat contract as _flash_fwd: tagged so dots_flash saves the
+  # kernel outputs instead of re-running the forward under jax.checkpoint.
+  out = checkpoint_name(out, "flash_out")
+  lse8 = checkpoint_name(lse8, "flash_lse")
+  return (out, lse8[:, :, 0, :]), (q, k, v, out, lse8)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, residuals, cts):
+  dout, dlse = cts
+  return _bwd(causal, block_q, block_k, residuals, dout, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q, k, v, causal: bool = True,
+                        block_q: Optional[int] = None,
+                        block_k: Optional[int] = None):
+  """Like :func:`flash_attention` but also returns the per-position
+  log-sum-exp, fp32 ``[B, S, H]`` — the quantity needed to MERGE
+  attention over KV chunks (ring attention / blockwise decoding):
+  given per-chunk ``(o_c, lse_c)``, the combined output is
+  ``sum_c o_c * exp(lse_c - logaddexp_c(lse_c))``.  The vjp accepts a
+  cotangent for lse (folded into the kernel's delta term)."""
+  B, S, H, D = q.shape
+  bq = min(block_q, S) if block_q else _default_block(S)
+  bk = min(block_k, S) if block_k else _default_block(S)
+  if S % bq or S % bk:
+    raise ValueError(f"seq len {S} must divide block sizes ({bq}, {bk})")
+  qt = q.transpose(0, 2, 1, 3)
+  kt = k.transpose(0, 2, 1, 3)
+  vt = v.transpose(0, 2, 1, 3)
+  out, lse = _flash_lse(qt, kt, vt, causal, bq, bk)
+  return out.transpose(0, 2, 1, 3), lse.transpose(0, 2, 1)
 
 
 def _default_block(S: int, want: int = 512) -> int:

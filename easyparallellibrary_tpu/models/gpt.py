@@ -145,8 +145,14 @@ class CausalSelfAttention(nn.Module):
       from easyparallellibrary_tpu.kernels.flash_attention import (
           flash_attention)
       out = flash_attention(q, k, v, causal=True)
-    else:
+    elif cfg.attn_impl == "xla":
       out = _dense_causal_attention(q, k, v, cfg.dtype)
+    else:
+      # A typo'd impl silently falling back to dense attention would
+      # mislabel any benchmark run on top of it.
+      raise ValueError(
+          f"attn_impl must be 'xla', 'pallas_flash', 'ring' or "
+          f"'ulysses'; got {cfg.attn_impl!r}")
 
     out = out.reshape(B, S, D)
     out = Dense(D, parallel=row, use_bias=False, dtype=cfg.dtype,

@@ -5,24 +5,33 @@ Green-field subsystem: the reference has NO sequence/context parallelism
 nearest building block is the grouped send/recv AllToAll family,
 csrc/communicators/tensorflow_nccl.h:186-301).
 
-Design (blockwise attention with online softmax, Liu et al. ring
-attention): the sequence dim is split into one block per ``seq``-axis
-device.  Each ring step, every query block attends to the KV block it
-currently holds, accumulating (max, denominator, numerator) in fp32;
-then the KV blocks rotate one position around the ring.  Expressed in
-global-array form: the rotate is ``jnp.roll`` along the seq-sharded
-block dim, which XLA lowers to a collective-permute over the ICI ring —
-compute on the current block overlaps the transfer of the next.
+Blockwise attention with online softmax (Liu et al. ring attention):
+the sequence dim is split into one block per ``seq``-axis device; each
+ring step every query block attends to the KV block it currently holds,
+then KV rotates one position around the ICI ring — compute on the
+current block overlaps the transfer of the next.  Two implementations:
 
-Causality is enforced block-wise: a query block fully attends to earlier
-blocks, triangularly to its own, not at all to later ones — fully-masked
-ring steps still rotate but contribute zeros (their compute is dead
-weight only when n is large; XLA removes the masked matmul for the
-skipped pairs when it can).
+* **flash ring** (default, ``sequence.ring_impl="flash"``): shard_map
+  over the seq axis, the Pallas flash kernel as the per-block compute,
+  explicit ``lax.ppermute`` rotation, and a custom_vjp backward that
+  RE-COMMUNICATES the KV blocks instead of saving them — per-device
+  live memory stays O(S/n) in both passes, which is the point of ring
+  attention.  (XLA cannot partition a pallas custom call, hence the
+  shard_map.)
 
-Each ring step is wrapped in `jax.checkpoint` so the backward pass
-rematerializes per-step scores: peak memory stays O(block²) instead of
-O(seq²) — the entire point of ring attention.
+* **einsum ring** (``ring_impl="einsum"``, or automatically when
+  ``sequence.block_size``/``num_blocks`` asks for finer-than-device
+  blocking): global-array form — the rotate is ``jnp.roll`` along the
+  seq-sharded block dim (lowered to collective-permute by GSPMD), each
+  step wrapped in ``jax.checkpoint`` so backward rematerializes
+  per-step scores.  Composes with any surrounding GSPMD program.
+
+Causality is enforced block-wise in both: a query block fully attends
+to earlier blocks, triangularly to its own, not at all to later ones —
+fully-masked ring steps still rotate but contribute zeros (uniform SPMD
+work; the ~2x causal inefficiency of the contiguous block layout is a
+known trade — a striped/zigzag layout that load-balances the causal
+mask is a possible future refinement).
 """
 
 from __future__ import annotations
@@ -93,13 +102,155 @@ def _ring_step(qb, kb, vb, acc, r, n, causal):
   return new_o, new_m, new_l
 
 
+# ----------------------------------------------------- flash ring path --
+#
+# The design-point implementation: shard_map over the seq axis, the
+# Pallas flash kernel as the per-block compute, explicit ppermute KV
+# rotation, and a custom_vjp backward that RE-COMMUNICATES the KV blocks
+# instead of saving them — per-device live memory stays O(S/n) in both
+# passes, which is the entire point of ring attention.  (The global-array
+# einsum path below stays as the GSPMD-composable fallback: XLA cannot
+# partition a pallas custom call, so the kernel path must be a shard_map.)
+#
+# Backward math: with the GLOBAL logsumexp L saved from the forward,
+# every per-block backward is an ordinary flash backward against L —
+# p = exp(s - L) is the globally-normalized probability block, so the
+# standard ds = p * (dp - delta) with delta = rowsum(dO * O) is exact per
+# block and dk/dv accumulate additively as their block rides the ring
+# (they rotate WITH the block and arrive home after n steps).
+
+
+def _rot(x, n):
+  return jax.lax.ppermute(x, constants.SEQ_AXIS,
+                          [(i, (i + 1) % n) for i in range(n)])
+
+
+def _ring_fwd_pass(n, causal, q, k0, v0):
+  """Per-device ring forward in kernel layout [B, H, s, D].  Returns the
+  merged (O fp32, L fp32 [B, H, s])."""
+  from easyparallellibrary_tpu.kernels.flash_attention import (
+      _default_block, _fwd)
+  s = q.shape[2]
+  bq = bk = _default_block(s)
+  idx = jax.lax.axis_index(constants.SEQ_AXIS) if n > 1 else 0
+  O = jnp.zeros(q.shape, jnp.float32)
+  L = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+  k_cur, v_cur = k0, v0
+  for r in range(n):
+    o_r, lse8 = _fwd(q, k_cur, v_cur, causal and r == 0, bq, bk)
+    lse_r = lse8[:, :, 0, :]
+    if causal and r > 0:
+      # Device idx holds KV block (idx - r) mod n at step r: wrapped
+      # blocks (idx < r) are entirely in the future — masked out.
+      masked = idx < r
+      lse_r = jnp.where(masked, NEG_INF, lse_r)
+      o_r = jnp.where(masked, jnp.zeros_like(o_r), o_r)
+    L_new = jnp.logaddexp(L, lse_r)
+    O = (O * jnp.exp(L - L_new)[..., None]
+         + o_r.astype(jnp.float32) * jnp.exp(lse_r - L_new)[..., None])
+    L = L_new
+    if r != n - 1:
+      k_cur = _rot(k_cur, n)
+      v_cur = _rot(v_cur, n)
+  return O, L
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ring_local(n, causal, q, k0, v0):
+  O, _ = _ring_fwd_pass(n, causal, q, k0, v0)
+  return O.astype(q.dtype)
+
+
+def _ring_local_fwd(n, causal, q, k0, v0):
+  O, L = _ring_fwd_pass(n, causal, q, k0, v0)
+  out = O.astype(q.dtype)
+  return out, (q, k0, v0, out, L)
+
+
+def _ring_local_bwd(n, causal, residuals, dO):
+  from easyparallellibrary_tpu.kernels.flash_attention import (
+      _bwd_kernels, _default_block, _tile8)
+  q, k0, v0, O, L = residuals
+  s = q.shape[2]
+  bq = bk = _default_block(s)
+  idx = jax.lax.axis_index(constants.SEQ_AXIS) if n > 1 else 0
+  dO = dO.astype(q.dtype)
+  delta = jnp.sum(dO.astype(jnp.float32) * O.astype(jnp.float32), axis=-1)
+  L8, delta8 = _tile8(L), _tile8(delta)
+  dq = jnp.zeros(q.shape, jnp.float32)
+  k_cur, v_cur = k0, v0
+  dk_cur = jnp.zeros(k0.shape, jnp.float32)
+  dv_cur = jnp.zeros(v0.shape, jnp.float32)
+  for r in range(n):
+    dq_r, dk_r, dv_r = _bwd_kernels(q, k_cur, v_cur, dO, L8, delta8,
+                                    causal and r == 0, bq, bk)
+    if causal and r > 0:
+      masked = idx < r
+      dq_r = jnp.where(masked, jnp.zeros_like(dq_r), dq_r)
+      dk_r = jnp.where(masked, jnp.zeros_like(dk_r), dk_r)
+      dv_r = jnp.where(masked, jnp.zeros_like(dv_r), dv_r)
+    dq = dq + dq_r.astype(jnp.float32)
+    dk_cur = dk_cur + dk_r.astype(jnp.float32)
+    dv_cur = dv_cur + dv_r.astype(jnp.float32)
+    # Rotate grads WITH their block every step (n rotations total) so
+    # each dk/dv arrives back at its block's home device; k/v themselves
+    # are not read after the last step.
+    if r != n - 1:
+      k_cur, v_cur = _rot(k_cur, n), _rot(v_cur, n)
+    dk_cur, dv_cur = _rot(dk_cur, n), _rot(dv_cur, n)
+  return dq.astype(q.dtype), dk_cur.astype(k0.dtype), dv_cur.astype(v0.dtype)
+
+
+_ring_local.defvjp(_ring_local_fwd, _ring_local_bwd)
+
+
+def _ring_flash(q, k, v, causal: bool):
+  env = Env.get()
+  mesh = env.cluster._mesh
+  n = env.cluster.axis_size(constants.SEQ_AXIS)
+  B, S, H, D = q.shape
+
+  def local(q_l, k_l, v_l):
+    qt = q_l.transpose(0, 2, 1, 3)
+    kt = k_l.transpose(0, 2, 1, 3)
+    vt = v_l.transpose(0, 2, 1, 3)
+    out = _ring_local(n, causal, qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+  # Batch on data, sequence on seq, heads on model (survives TP head
+  # sharding); stage/expert axes replicated.  A dim that doesn't divide
+  # its mesh axis is computed replicated instead (correct, just
+  # redundant — only reachable off the models' padded-even shapes).
+  bax = constants.DATA_AXIS if B % mesh.shape[constants.DATA_AXIS] == 0 \
+      else None
+  hax = constants.MODEL_AXIS if H % mesh.shape[constants.MODEL_AXIS] == 0 \
+      else None
+  spec = P(bax, constants.SEQ_AXIS, hax, None)
+  return jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
+                       out_specs=spec, check_vma=False)(q, k, v)
+
+
 def ring_attention(q, k, v, causal: bool = True,
                    num_blocks: Optional[int] = None):
   """Blockwise ring attention; q, k, v: [B, S, H, D] (seq-sharded under
-  GSPMD).  Returns [B, S, H, D].  Falls back to one block (= standard
-  blockwise attention) when no seq axis is active."""
+  GSPMD).  Returns [B, S, H, D].
+
+  With an active ``seq`` mesh axis (and no explicit ``num_blocks``
+  override), dispatches to the shard_map + Pallas-flash ring
+  (``sequence.ring_impl="flash"``, the default); set
+  ``sequence.ring_impl="einsum"`` or pass ``num_blocks`` for the
+  global-array einsum formulation (GSPMD-composable, e.g. finer
+  blocking via ``sequence.block_size``).  Falls back to one block
+  (= standard blockwise attention) when no seq axis is active."""
   B, S, H, D = q.shape
   axis = max(_seq_axis_size(), 1)
+  seq_cfg = Env.get().config.sequence
+  if (axis > 1 and num_blocks is None and seq_cfg.ring_impl == "flash"
+      and not seq_cfg.block_size):  # finer blocking → einsum path
+    if S % axis:
+      raise ValueError(f"sequence length {S} not divisible by "
+                       f"{axis} ring devices")
+    return _ring_flash(q, k, v, causal)
   if num_blocks is None:
     n = axis
     # Finer blocking than one block per device when sequence.block_size

@@ -86,3 +86,60 @@ def test_gpt_with_pallas_flash_matches_xla():
   out_flash = flash_model.apply({"params": params}, ids)
   out_xla = xla_model.apply({"params": params}, ids)
   np.testing.assert_allclose(out_flash, out_xla, rtol=2e-4, atol=2e-5)
+
+
+def _ref_with_lse(q, k, v, causal=True):
+  B, S, H, D = q.shape
+  s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+  if causal:
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    s = jnp.where(mask[None, None], s, -1e30)
+  lse = jax.nn.logsumexp(s, axis=-1)                        # [B, H, S]
+  p = jnp.exp(s - lse[..., None])
+  o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+  return o, lse.transpose(0, 2, 1)                          # [B, S, H]
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_lse_matches_full(causal):
+  from easyparallellibrary_tpu.kernels.flash_attention import (
+      flash_attention_lse)
+  q, k, v = _qkv(S=64, seed=7)
+  o1, l1 = flash_attention_lse(q, k, v, causal=causal)
+  o2, l2 = _ref_with_lse(q, k, v, causal=causal)
+  np.testing.assert_allclose(o1, o2, rtol=2e-5, atol=2e-6)
+  np.testing.assert_allclose(l1, l2, rtol=2e-5, atol=2e-6)
+
+
+def test_flash_lse_cotangent_grads():
+  """The lse output is differentiable: its cotangent folds into the
+  kernel's delta term (ds = p*(dp - delta + dlse)); this is what the
+  ring-attention merge relies on."""
+  from easyparallellibrary_tpu.kernels.flash_attention import (
+      flash_attention_lse)
+  q, k, v = _qkv(S=32, D=16, seed=9)
+
+  def loss_flash(q, k, v):
+    o, l = flash_attention_lse(q, k, v, causal=True)
+    return jnp.sum(o ** 2) + jnp.sum(jnp.sin(l))
+
+  def loss_ref(q, k, v):
+    o, l = _ref_with_lse(q, k, v, causal=True)
+    return jnp.sum(o ** 2) + jnp.sum(jnp.sin(l))
+
+  g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+  g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+  for a, b in zip(g1, g2):
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_unknown_attn_impl_raises():
+  import easyparallellibrary_tpu as epl
+  from easyparallellibrary_tpu.models import GPT, GPTConfig
+  epl.init()
+  model = GPT(GPTConfig(vocab_size=64, num_layers=1, num_heads=2,
+                        d_model=16, d_ff=32, max_seq_len=16,
+                        attn_impl="flash"))  # typo for pallas_flash
+  ids = jnp.zeros((1, 16), jnp.int32)
+  with pytest.raises(ValueError, match="attn_impl"):
+    model.init(jax.random.PRNGKey(0), ids)
