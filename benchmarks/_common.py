@@ -15,7 +15,6 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
@@ -35,15 +34,10 @@ def null_round_trip():
 
 
 def xla_attention(q, k, v):
-  """The models' XLA attention path (models/gpt.py attend): bf16
-  einsums, fp32 softmax, causal."""
-  d = q.shape[-1]
-  s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
-  S = q.shape[1]
-  mask = jnp.tril(jnp.ones((S, S), bool))
-  s = jnp.where(mask, s, -1e30)
-  p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
-  return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+  """The models' actual XLA attention path — imported, not copied, so
+  the benchmark baseline can never drift from what the model computes."""
+  from easyparallellibrary_tpu.models.gpt import _dense_causal_attention
+  return _dense_causal_attention(q, k, v, q.dtype)
 
 
 def time_attn_grad(attn, q, k, v, steps=20):

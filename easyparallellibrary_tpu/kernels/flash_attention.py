@@ -335,7 +335,13 @@ def flash_attention_lse(q, k, v, causal: bool = True,
   attention over KV chunks (ring attention / blockwise decoding):
   given per-chunk ``(o_c, lse_c)``, the combined output is
   ``sum_c o_c * exp(lse_c - logaddexp_c(lse_c))``.  The vjp accepts a
-  cotangent for lse (folded into the kernel's delta term)."""
+  cotangent for lse (folded into the kernel's delta term).
+
+  The bundled ring attention performs this merge against the same
+  ``_fwd``/``_bwd_kernels`` primitives directly in their [B, H, S, D]
+  layout (saving per-step transposes and using the global-LSE backward);
+  this wrapper is the layout-friendly public entry point for external
+  composition, e.g. KV-chunked decoding."""
   B, S, H, D = q.shape
   bq = min(block_q, S) if block_q else _default_block(S)
   bk = min(block_k, S) if block_k else _default_block(S)

@@ -5,9 +5,15 @@ FastNN); the benchmark matrix needs ResNet-50 for the pure-DP config and
 the `split(8)` large-vocab-head config (/root/repo/BASELINE.md rows 1, 3).
 
 TPU notes:
-  * GroupNorm instead of BatchNorm: batch-size independent and purely
+  * Default norm is GroupNorm: batch-size independent and purely
     functional (no mutable batch-stats collection), the common TPU
-    substitution.
+    substitution.  ``norm="batch"`` selects true BatchNorm — pair it
+    with :class:`parallel.MutableTrainState` /
+    :func:`parallel.make_mutable_train_step` (pass ``train=True`` and
+    ``mutable=["batch_stats"]`` through ``model.apply``).  Under GSPMD
+    the batch is one global (data-sharded) array, so the batch
+    statistics are computed over the GLOBAL batch — XLA inserts the
+    cross-replica reduction the reference would hand-build.
   * The classifier head is an `ops.Dense`, so a ``with epl.split():``
     around model application makes a huge-vocab head column-parallel —
     the reference's README flagship example (README.md:58-70).
@@ -33,6 +39,7 @@ class ResNetConfig:
   dtype: Any = jnp.bfloat16
   param_dtype: Any = jnp.float32
   norm_groups: int = 32
+  norm: str = "group"                           # group | batch
 
 
 def resnet18_config(**kw):
@@ -43,19 +50,29 @@ def resnet50_config(**kw):
   return ResNetConfig(stage_sizes=(3, 4, 6, 3), **kw)
 
 
+def _norm_factory(cfg: ResNetConfig, filters: int, train: bool):
+  if cfg.norm == "batch":
+    return partial(nn.BatchNorm, use_running_average=not train,
+                   momentum=0.9, dtype=cfg.dtype,
+                   param_dtype=cfg.param_dtype)
+  if cfg.norm == "group":
+    return partial(nn.GroupNorm, num_groups=min(cfg.norm_groups, filters),
+                   dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+  raise ValueError(f"norm must be 'group' or 'batch'; got {cfg.norm!r}")
+
+
 class BottleneckBlock(nn.Module):
   cfg: ResNetConfig
   filters: int
   strides: int = 1
+  train: bool = False
 
   @nn.compact
   def __call__(self, x):
     cfg = self.cfg
     conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype,
                    param_dtype=cfg.param_dtype)
-    norm = partial(nn.GroupNorm, num_groups=min(cfg.norm_groups,
-                                                self.filters),
-                   dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    norm = _norm_factory(cfg, self.filters, self.train)
     residual = x
     y = conv(self.filters, (1, 1))(x)
     y = nn.relu(norm()(y))
@@ -75,23 +92,20 @@ class ResNet(nn.Module):
   cfg: ResNetConfig
 
   @nn.compact
-  def __call__(self, x):
+  def __call__(self, x, train: bool = False):
     from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
     cfg = resolve_model_dtypes(self.cfg)
     x = x.astype(cfg.dtype)
     x = nn.Conv(cfg.num_filters, (7, 7), strides=(2, 2), use_bias=False,
                 dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                 name="conv_init")(x)
-    x = nn.relu(nn.GroupNorm(num_groups=min(cfg.norm_groups,
-                                            cfg.num_filters),
-                             dtype=cfg.dtype,
-                             param_dtype=cfg.param_dtype)(x))
+    x = nn.relu(_norm_factory(cfg, cfg.num_filters, train)()(x))
     x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
     for i, n_blocks in enumerate(cfg.stage_sizes):
       for j in range(n_blocks):
         strides = 2 if i > 0 and j == 0 else 1
         x = BottleneckBlock(cfg, cfg.num_filters * 2 ** i, strides,
-                            name=f"stage{i}_block{j}")(x)
+                            train=train, name=f"stage{i}_block{j}")(x)
     x = jnp.mean(x, axis=(1, 2))
     # Classifier head: column-parallel under an active `split` scope.
     logits = Dense(cfg.num_classes, dtype=jnp.float32,

@@ -162,8 +162,15 @@ def _ring_local(n, causal, q, k0, v0):
 
 
 def _ring_local_fwd(n, causal, q, k0, v0):
+  from jax.ad_checkpoint import checkpoint_name
   O, L = _ring_fwd_pass(n, causal, q, k0, v0)
   out = O.astype(q.dtype)
+  # Same remat contract as the plain flash kernel: tag the residuals so
+  # the models' dots_flash policy SAVES them — without this, a
+  # jax.checkpoint around the layer would re-run the entire ring forward
+  # (n kernels + n-1 ppermutes) during the backward.
+  out = checkpoint_name(out, "flash_out")
+  L = checkpoint_name(L, "flash_lse")
   return out, (q, k0, v0, out, L)
 
 

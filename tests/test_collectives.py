@@ -184,3 +184,33 @@ def test_batch_all_reduce_communicator_pool_bound():
   out = f(tree)
   for leaf in out:
     np.testing.assert_allclose(leaf, jnp.full((mb,), 8.0))
+
+
+def test_communicator_pool_serialization_in_lowered_hlo():
+  """num_communicators=n is not just accepted — it materializes as an
+  optimization-barrier chain in the lowered program (bucket i's input
+  tied to bucket i-n's result), the structural analog of the reference
+  pool's per-communicator serial control deps
+  (epl/communicators/communication_pool.py:92-104)."""
+  mesh = _mesh1d()
+  # The plan is built inside shard_map on LOCAL shards: 1 MB per shard
+  # per leaf (8 MB global) with a 1 MB threshold -> one bucket per leaf.
+  elems = 8 * 1024 * 1024 // 4
+  tree = [jnp.ones((elems,)) for _ in range(6)]
+  spec = [P("data")] * 6
+
+  def lowered_text(n):
+    f = _smap(functools.partial(batch_all_reduce, axis_name="data",
+                                fusion_threshold_mb=1,
+                                num_communicators=n),
+              mesh, (spec,), spec)
+    return jax.jit(f).lower(tree).as_text()
+
+  free = lowered_text(0)
+  pooled = lowered_text(2)
+  barrier = "stablehlo.optimization_barrier"
+  op = 'stablehlo.all_reduce"'
+  assert free.count(op) == 6 and pooled.count(op) == 6
+  assert free.count(barrier) == 0
+  # 6 one-leaf buckets, pool of 2: buckets 2..5 each wait on i-2.
+  assert pooled.count(barrier) == 4
