@@ -108,6 +108,20 @@ class AutoParallelConfig(_Category):
       # (reference policies: balance-op-num / repeated-layers / heuristic,
       # epl/parallel/planner.py:66-112).
       "stage_policy": "balance_param",
+      # Auto tensor-split placement (the reference leaves this TODO,
+      # epl/ir/graph.py:124): inside a `split` scope, auto-named sibling
+      # Dense layers alternate column -> row (Megatron pairing), so
+      # back-to-back projections chain through a sharded activation with
+      # a single psum instead of an activation all-gather.  Explicit
+      # `parallel=` always wins; numerics are unchanged either way
+      # (GSPMD inserts whatever collectives the placement implies).
+      # Opt-in: the pairing is positional, so NON-chained auto-named
+      # siblings (parallel branches off one input) would trade their
+      # free column placement for a psum, and row-mode kernels pad the
+      # CONTRACTION dim, so uneven-dim checkpoints saved with the flag
+      # off do not load with it on.  Annotate explicitly where it
+      # matters.
+      "tensor_split": False,
   }
 
 
@@ -260,6 +274,10 @@ class SequenceConfig(_Category):
       # composable; used automatically when num_blocks/block_size asks
       # for finer-than-device blocking).
       "ring_impl": "flash",
+      # Same choice for Ulysses' head-sharded attention region: "flash"
+      # runs the Pallas kernel per device (no [S, S] scores), "einsum"
+      # keeps the pure sharding-constraint formulation.
+      "ulysses_impl": "flash",
   }
 
 
@@ -347,6 +365,9 @@ class Config:
     if self.sequence.ring_impl not in ("flash", "einsum"):
       raise ValueError("sequence.ring_impl must be 'flash' or 'einsum'; "
                        f"got {self.sequence.ring_impl!r}")
+    if self.sequence.ulysses_impl not in ("flash", "einsum"):
+      raise ValueError("sequence.ulysses_impl must be 'flash' or "
+                       f"'einsum'; got {self.sequence.ulysses_impl!r}")
     if self.pipeline.num_micro_batch < 1:
       raise ValueError("pipeline.num_micro_batch must be >= 1")
     if self.pipeline.num_stages < 1:

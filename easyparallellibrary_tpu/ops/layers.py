@@ -26,6 +26,7 @@ Sharding layouts (Megatron-style, expressed as GSPMD metadata):
 
 from __future__ import annotations
 
+import re
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
@@ -104,6 +105,19 @@ class Dense(nn.Module):
     mode = self.parallel
     if mode == "auto":
       mode = "column" if _active_split() is not None else "none"
+      if mode == "column" and Env.get().config.auto.tensor_split:
+        # Auto tensor-split (reference TODO, epl/ir/graph.py:124):
+        # alternate column -> row across auto-named sibling Dense layers
+        # (flax names them Dense_0, Dense_1, ... within a parent), the
+        # Megatron pairing — an MLP's up-projection shards the feature
+        # dim and the down-projection contracts it with one psum, no
+        # activation gather between them.  The flax auto-name is the
+        # trace-stable key (a per-scope counter would drift across
+        # init/eval_shape/jit retraces).  Explicitly named layers keep
+        # column; explicit `parallel=` never reaches this branch.
+        m = re.fullmatch(r"Dense_(\d+)", self.name or "")
+        if m and int(m.group(1)) % 2 == 1:
+          mode = "row"
     if mode not in ("none", "column", "row"):
       raise ValueError(f"Dense.parallel must be auto/none/column/row, "
                        f"got {self.parallel!r}")

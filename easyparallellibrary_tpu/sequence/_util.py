@@ -1,0 +1,10 @@
+"""Shared helpers for the sequence-parallel shard_map paths."""
+
+from __future__ import annotations
+
+
+def axis_if_divisible(dim_size: int, mesh, axis_name: str):
+  """`axis_name` when the dimension divides that mesh axis, else None
+  (the dim is computed replicated over the axis — correct, just
+  redundant; only reachable off the models' padded-even shapes)."""
+  return axis_name if dim_size % mesh.shape[axis_name] == 0 else None

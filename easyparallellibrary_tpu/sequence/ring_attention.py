@@ -225,13 +225,10 @@ def _ring_flash(q, k, v, causal: bool):
     return out.transpose(0, 2, 1, 3)
 
   # Batch on data, sequence on seq, heads on model (survives TP head
-  # sharding); stage/expert axes replicated.  A dim that doesn't divide
-  # its mesh axis is computed replicated instead (correct, just
-  # redundant — only reachable off the models' padded-even shapes).
-  bax = constants.DATA_AXIS if B % mesh.shape[constants.DATA_AXIS] == 0 \
-      else None
-  hax = constants.MODEL_AXIS if H % mesh.shape[constants.MODEL_AXIS] == 0 \
-      else None
+  # sharding); stage/expert axes replicated.
+  from easyparallellibrary_tpu.sequence._util import axis_if_divisible
+  bax = axis_if_divisible(B, mesh, constants.DATA_AXIS)
+  hax = axis_if_divisible(H, mesh, constants.MODEL_AXIS)
   spec = P(bax, constants.SEQ_AXIS, hax, None)
   return jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
                        out_specs=spec, check_vma=False)(q, k, v)

@@ -38,18 +38,56 @@ SEQ_SHARDED = P(constants.DATA_AXIS, constants.SEQ_AXIS, None, None)
 HEAD_SHARDED = P(constants.DATA_AXIS, None, constants.SEQ_AXIS, None)
 
 
+def _ulysses_flash(q, k, v, causal: bool):
+  """Head-sharded region as a shard_map with the Pallas flash kernel:
+  GSPMD inserts all-to-all #1 to meet the shard_map's head-sharded entry
+  spec, each device runs flash over the FULL sequence for its head
+  subset (no [S, S] score materialization), and the exit constraint back
+  to sequence sharding is all-to-all #2."""
+  from easyparallellibrary_tpu.kernels.flash_attention import (
+      flash_attention)
+  from easyparallellibrary_tpu.sequence._util import axis_if_divisible
+  env = Env.get()
+  mesh = env.cluster._mesh
+  B, _, H, _ = q.shape
+  bax = axis_if_divisible(B, mesh, constants.DATA_AXIS)
+  # Heads shard over seq AND model jointly: under hybrid TP+Ulysses the
+  # inputs arrive head-sharded on the model axis already, and dropping
+  # that axis from the spec would all-gather q/k/v and repeat the same
+  # flash work on every TP rank.
+  n_model = mesh.shape[constants.MODEL_AXIS]
+  n_seq = mesh.shape[constants.SEQ_AXIS]
+  if H % (n_seq * n_model) == 0 and n_model > 1:
+    head_axes = (constants.SEQ_AXIS, constants.MODEL_AXIS)
+  else:
+    head_axes = constants.SEQ_AXIS
+  spec = P(bax, None, head_axes, None)
+
+  def local(q_l, k_l, v_l):
+    return flash_attention(q_l, k_l, v_l, causal=causal)
+
+  out = jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
+                      out_specs=spec, check_vma=False)(q, k, v)
+  return _constrain(out, SEQ_SHARDED)
+
+
 def ulysses_attention(q, k, v, causal: bool = True):
   """q, k, v: [B, S, H, D] seq-sharded → attention → [B, S, H, D].
 
-  The head-sharded region computes standard full-sequence attention, so
-  any attention kernel (XLA einsum here, a Pallas flash kernel in
-  kernels/) drops in unchanged.
+  The head-sharded region computes standard full-sequence attention for
+  a head subset.  With ``sequence.ulysses_impl="flash"`` (default, on an
+  active seq axis) that region is a shard_map running the Pallas flash
+  kernel per device; ``"einsum"`` keeps the pure-GSPMD formulation
+  (sharding constraints around a dense attention — composable anywhere,
+  but materializes the per-head [S, S] scores).
   """
   B, S, H, D = q.shape
   n = _seq_axis_size()
   if n > 1 and H % n != 0:
     raise ValueError(f"Ulysses requires num_heads ({H}) divisible by the "
                      f"seq axis size ({n})")
+  if n > 1 and Env.get().config.sequence.ulysses_impl == "flash":
+    return _ulysses_flash(q, k, v, causal)
 
   # all-to-all #1: seq-sharded -> head-sharded (full sequence locally).
   q = _constrain(q, HEAD_SHARDED)

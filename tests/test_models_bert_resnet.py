@@ -150,9 +150,9 @@ def test_resnet_batchnorm_variant_trains():
   statistics are global-batch statistics.  NOTES round-1 deferred item."""
   from easyparallellibrary_tpu.models.resnet import ResNetConfig
   from easyparallellibrary_tpu.parallel import (
-      MutableTrainState, make_mutable_train_step, state_shardings)
+      MutableTrainState, make_mutable_train_step)
 
-  env = epl.init()
+  epl.init()
   with epl.replicate(1):
     pass
   mesh = epl.current_plan().build_mesh()
