@@ -345,7 +345,7 @@ def flash_attention_lse(q, k, v, causal: bool = True,
   B, S, H, D = q.shape
   bq = min(block_q, S) if block_q else _default_block(S)
   bk = min(block_k, S) if block_k else _default_block(S)
-  if S % bq or S % bk:
+  if not bq or not bk or S % bq or S % bk:
     raise ValueError(f"seq len {S} must divide block sizes ({bq}, {bk})")
   qt = q.transpose(0, 2, 1, 3)
   kt = k.transpose(0, 2, 1, 3)
@@ -356,13 +356,22 @@ def flash_attention_lse(q, k, v, causal: bool = True,
 
 def _default_block(S: int, want: int = 512) -> int:
   """Largest block <= `want` that divides S (halving from `want`, floor
-  8 to stay sublane-aligned); S itself when shorter than `want`."""
+  8 to stay sublane-aligned); S itself when shorter than `want`;
+  0 when NO such block divides S (e.g. S = 515) — callers must either
+  raise or fall back to a non-kernel path, never truncate the grid."""
   if S <= want:
     return S
   b = want
   while b > 8 and S % b:
     b //= 2
-  return b if S % b == 0 else 8
+  return b if S % b == 0 else 0
+
+
+def flash_blockable(S: int) -> bool:
+  """Whether the flash kernels can tile sequence length S with the
+  default block search (dispatchers use this to fall back to einsum
+  formulations instead of raising)."""
+  return _default_block(S) > 0
 
 
 def flash_attention(q, k, v, causal: bool = True,
@@ -382,7 +391,7 @@ def flash_attention(q, k, v, causal: bool = True,
   B, S, H, D = q.shape
   bq = min(block_q, S) if block_q else _default_block(S)
   bk = min(block_k, S) if block_k else _default_block(S)
-  if S % bq or S % bk:
+  if not bq or not bk or S % bq or S % bk:
     raise ValueError(f"seq len {S} must divide block sizes ({bq}, {bk})")
   # Kernels use [B, H, S, D] layout.
   qt = q.transpose(0, 2, 1, 3)

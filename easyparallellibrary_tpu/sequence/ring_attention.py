@@ -211,17 +211,239 @@ def _ring_local_bwd(n, causal, residuals, dO):
 _ring_local.defvjp(_ring_local_fwd, _ring_local_bwd)
 
 
+# ------------------------------------------------- zigzag causal layout --
+#
+# The contiguous layout wastes ~2x on causal masks: at ring step r the
+# first r devices hold wholly-future KV and contribute zeros (but SPMD
+# runs their kernels anyway).  The zigzag layout assigns each device TWO
+# half-chunks — chunk c and chunk 2n-1-c of 2n global chunks — so every
+# device holds one "early" and one "late" piece and the causal work per
+# step is uniform: one always-live half-pair (late queries vs early KV)
+# plus one selected half-pair ((early q, early k) when the visiting block
+# is older, (late q, late k) when it is newer).  Total causal compute
+# drops from n full-block kernels to 3/4 + (n-1)/2 half-block work ≈ half.
+#
+# The layout exchange happens INSIDE the shard_map on entry/exit (two
+# ppermutes each way, O(S·D) — negligible next to the O(S²/n·D) kernel
+# work it halves) and is plain traced code, so autodiff transposes the
+# ppermutes for the backward automatically; only the ring itself is a
+# custom_vjp.
+
+
+def _halves(x):
+  h = x.shape[2] // 2
+  return x[:, :, :h], x[:, :, h:]
+
+
+def _zig_entry(x, n):
+  """Contiguous shard (chunks 2i, 2i+1) -> zigzag (chunks i, 2n-1-i)."""
+  idx = jax.lax.axis_index(constants.SEQ_AXIS)
+  a, b = _halves(x)
+  evens = jax.lax.ppermute(
+      a, constants.SEQ_AXIS,
+      [(i, 2 * i if 2 * i < n else 2 * n - 1 - 2 * i) for i in range(n)])
+  odds = jax.lax.ppermute(
+      b, constants.SEQ_AXIS,
+      [(i, 2 * i + 1 if 2 * i + 1 < n else 2 * n - 2 - 2 * i)
+       for i in range(n)])
+  even_dev = (idx % 2 == 0)
+  new_a = jnp.where(even_dev, evens, odds)   # chunk idx (parity == idx's)
+  new_b = jnp.where(even_dev, odds, evens)   # chunk 2n-1-idx
+  return jnp.concatenate([new_a, new_b], axis=2)
+
+
+def _zig_exit(x, n):
+  """Inverse of :func:`_zig_entry`."""
+  idx = jax.lax.axis_index(constants.SEQ_AXIS)
+  a, b = _halves(x)
+  even_dev = (idx % 2 == 0)
+  even_chunk = jnp.where(even_dev, a, b)     # chunk idx or 2n-1-idx, even
+  odd_chunk = jnp.where(even_dev, b, a)
+  evens = jax.lax.ppermute(
+      even_chunk, constants.SEQ_AXIS,
+      [(i, (i if i % 2 == 0 else 2 * n - 1 - i) // 2) for i in range(n)])
+  odds = jax.lax.ppermute(
+      odd_chunk, constants.SEQ_AXIS,
+      [(i, ((2 * n - 1 - i) if i % 2 == 0 else i) // 2) for i in range(n)])
+  return jnp.concatenate([evens, odds], axis=2)
+
+
+def _merge(o1, l1, o2, l2):
+  """LSE-merge two (output, logsumexp) contributions (fp32)."""
+  l = jnp.logaddexp(l1, l2)
+  o = (o1 * jnp.exp(l1 - l)[..., None] + o2 * jnp.exp(l2 - l)[..., None])
+  return o, l
+
+
+def _zz_fwd_pass(n, q, k0, v0):
+  """Zigzag causal ring forward ([B, H, s, D] locals, s = 2 half-chunks).
+  Returns merged (O fp32, L fp32)."""
+  from easyparallellibrary_tpu.kernels.flash_attention import (
+      _default_block, _fwd)
+  half = q.shape[2] // 2
+  bq = bk = _default_block(half)
+  idx = jax.lax.axis_index(constants.SEQ_AXIS)
+  qa, qb = _halves(q)
+
+  def fwd_half(qh, kh, vh, causal):
+    o, lse8 = _fwd(qh, kh, vh, causal, bq, bk)
+    return o.astype(jnp.float32), lse8[:, :, 0, :]
+
+  O = jnp.zeros(q.shape, jnp.float32)
+  L = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+  k_cur, v_cur = k0, v0
+  for r in range(n):
+    ka, kb = _halves(k_cur)
+    va, vb = _halves(v_cur)
+    if r == 0:
+      o_a, l_a = fwd_half(qa, ka, va, True)          # diag (early, early)
+      o1, l1 = fwd_half(qb, ka, va, False)           # late q vs early k
+      o2, l2 = fwd_half(qb, kb, vb, True)            # diag (late, late)
+      o_b, l_b = _merge(o1, l1, o2, l2)
+    else:
+      # Visiting block j = (idx - r) mod n.  cond: j < idx (no wrap) —
+      # then (qa, ka) is live (early q sees older early k); wrapped
+      # (j > idx) makes (qb, kb) live instead (late q sees older late k).
+      cond = idx >= r
+      q_sel = jnp.where(cond, qa, qb)
+      k_sel = jnp.where(cond, ka, kb)
+      v_sel = jnp.where(cond, va, vb)
+      o_aw, l_aw = fwd_half(qb, ka, va, False)       # always live
+      o_sl, l_sl = fwd_half(q_sel, k_sel, v_sel, False)
+      o_a = jnp.where(cond, o_sl, 0.0)
+      l_a = jnp.where(cond, l_sl, NEG_INF)
+      o_b, l_b = _merge(o_aw, l_aw,
+                        jnp.where(cond, 0.0, o_sl),
+                        jnp.where(cond, NEG_INF, l_sl))
+    o_r = jnp.concatenate([o_a, o_b], axis=2)
+    lse_r = jnp.concatenate([l_a, l_b], axis=2)
+    L_new = jnp.logaddexp(L, lse_r)
+    O = (O * jnp.exp(L - L_new)[..., None]
+         + o_r * jnp.exp(lse_r - L_new)[..., None])
+    L = L_new
+    if r != n - 1:
+      k_cur, v_cur = _rot(k_cur, n), _rot(v_cur, n)
+  return O, L
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ring_local_zz(n, q, k0, v0):
+  O, _ = _zz_fwd_pass(n, q, k0, v0)
+  return O.astype(q.dtype)
+
+
+def _ring_local_zz_fwd(n, q, k0, v0):
+  from jax.ad_checkpoint import checkpoint_name
+  O, L = _zz_fwd_pass(n, q, k0, v0)
+  out = checkpoint_name(O.astype(q.dtype), "flash_out")
+  L = checkpoint_name(L, "flash_lse")
+  return out, (q, k0, v0, out, L)
+
+
+def _ring_local_zz_bwd(n, residuals, dO):
+  """Recommunicating zigzag backward: same half-pair structure as the
+  forward, with each half-pair running the flash bwd kernels against the
+  GLOBAL per-half logsumexp, and dk/dv halves accumulating as their
+  block rides the ring home."""
+  from easyparallellibrary_tpu.kernels.flash_attention import (
+      _bwd_kernels, _default_block, _tile8)
+  q, k0, v0, O, L = residuals
+  half = q.shape[2] // 2
+  bq = bk = _default_block(half)
+  idx = jax.lax.axis_index(constants.SEQ_AXIS)
+  dO = dO.astype(q.dtype)
+  delta = jnp.sum(dO.astype(jnp.float32) * O.astype(jnp.float32), axis=-1)
+  qa, qb = _halves(q)
+  dOa, dOb = _halves(dO)
+  La, Lb = L[:, :, :half], L[:, :, half:]
+  da, db = delta[:, :, :half], delta[:, :, half:]
+  La8, Lb8, da8, db8 = _tile8(La), _tile8(Lb), _tile8(da), _tile8(db)
+
+  dqa = jnp.zeros(qa.shape, jnp.float32)
+  dqb = jnp.zeros(qb.shape, jnp.float32)
+  k_cur, v_cur = k0, v0
+  dk_cur = jnp.zeros(k0.shape, jnp.float32)
+  dv_cur = jnp.zeros(v0.shape, jnp.float32)
+
+  def bwd_half(qh, kh, vh, dOh, L8, d8, causal):
+    return _bwd_kernels(qh, kh, vh, dOh, L8, d8, causal, bq, bk)
+
+  for r in range(n):
+    ka, kb = _halves(k_cur)
+    va, vb = _halves(v_cur)
+    dka = jnp.zeros(ka.shape, jnp.float32)
+    dkb = jnp.zeros(kb.shape, jnp.float32)
+    dva = jnp.zeros(va.shape, jnp.float32)
+    dvb = jnp.zeros(vb.shape, jnp.float32)
+    if r == 0:
+      g = bwd_half(qa, ka, va, dOa, La8, da8, True)
+      dqa += g[0].astype(jnp.float32)
+      dka += g[1].astype(jnp.float32)
+      dva += g[2].astype(jnp.float32)
+      g = bwd_half(qb, ka, va, dOb, Lb8, db8, False)
+      dqb += g[0].astype(jnp.float32)
+      dka += g[1].astype(jnp.float32)
+      dva += g[2].astype(jnp.float32)
+      g = bwd_half(qb, kb, vb, dOb, Lb8, db8, True)
+      dqb += g[0].astype(jnp.float32)
+      dkb += g[1].astype(jnp.float32)
+      dvb += g[2].astype(jnp.float32)
+    else:
+      cond = idx >= r
+      g = bwd_half(qb, ka, va, dOb, Lb8, db8, False)     # always live
+      dqb += g[0].astype(jnp.float32)
+      dka += g[1].astype(jnp.float32)
+      dva += g[2].astype(jnp.float32)
+      q_sel = jnp.where(cond, qa, qb)
+      k_sel = jnp.where(cond, ka, kb)
+      v_sel = jnp.where(cond, va, vb)
+      dO_sel = jnp.where(cond, dOa, dOb)
+      L_sel = jnp.where(cond, La8, Lb8)
+      d_sel = jnp.where(cond, da8, db8)
+      gq, gk, gv = bwd_half(q_sel, k_sel, v_sel, dO_sel, L_sel, d_sel,
+                            False)
+      dqa += jnp.where(cond, gq, 0.0).astype(jnp.float32)
+      dqb += jnp.where(cond, 0.0, gq).astype(jnp.float32)
+      dka += jnp.where(cond, gk, 0.0).astype(jnp.float32)
+      dkb += jnp.where(cond, 0.0, gk).astype(jnp.float32)
+      dva += jnp.where(cond, gv, 0.0).astype(jnp.float32)
+      dvb += jnp.where(cond, 0.0, gv).astype(jnp.float32)
+    dk_cur = dk_cur + jnp.concatenate([dka, dkb], axis=2)
+    dv_cur = dv_cur + jnp.concatenate([dva, dvb], axis=2)
+    if r != n - 1:
+      k_cur, v_cur = _rot(k_cur, n), _rot(v_cur, n)
+    dk_cur, dv_cur = _rot(dk_cur, n), _rot(dv_cur, n)
+  dq = jnp.concatenate([dqa, dqb], axis=2)
+  return (dq.astype(q.dtype), dk_cur.astype(k0.dtype),
+          dv_cur.astype(v0.dtype))
+
+
+_ring_local_zz.defvjp(_ring_local_zz_fwd, _ring_local_zz_bwd)
+
+
 def _ring_flash(q, k, v, causal: bool):
   env = Env.get()
   mesh = env.cluster._mesh
   n = env.cluster.axis_size(constants.SEQ_AXIS)
   B, S, H, D = q.shape
+  # Zigzag only helps (and is only defined for) the causal case; needs
+  # an even per-device split into two half-chunks the kernels can tile.
+  from easyparallellibrary_tpu.kernels.flash_attention import (
+      flash_blockable)
+  zigzag = (env.config.sequence.ring_layout == "zigzag" and causal
+            and n > 1 and (S // n) % 2 == 0
+            and flash_blockable(S // n // 2))
 
   def local(q_l, k_l, v_l):
     qt = q_l.transpose(0, 2, 1, 3)
     kt = k_l.transpose(0, 2, 1, 3)
     vt = v_l.transpose(0, 2, 1, 3)
-    out = _ring_local(n, causal, qt, kt, vt)
+    if zigzag:
+      qt, kt, vt = (_zig_entry(x, n) for x in (qt, kt, vt))
+      out = _ring_local_zz(n, qt, kt, vt)
+      out = _zig_exit(out, n)
+    else:
+      out = _ring_local(n, causal, qt, kt, vt)
     return out.transpose(0, 2, 1, 3)
 
   # Batch on data, sequence on seq, heads on model (survives TP head
@@ -254,7 +476,13 @@ def ring_attention(q, k, v, causal: bool = True,
     if S % axis:
       raise ValueError(f"sequence length {S} not divisible by "
                        f"{axis} ring devices")
-    return _ring_flash(q, k, v, causal)
+    from easyparallellibrary_tpu.kernels.flash_attention import (
+        flash_blockable)
+    if flash_blockable(S // axis):
+      return _ring_flash(q, k, v, causal)
+    # Per-device block length the kernels can't tile (no power-of-two
+    # divisor <= 512): fall through to the einsum formulation rather
+    # than raise — it has no blocking constraint.
   if num_blocks is None:
     n = axis
     # Finer blocking than one block per device when sequence.block_size

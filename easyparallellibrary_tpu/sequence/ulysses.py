@@ -87,7 +87,13 @@ def ulysses_attention(q, k, v, causal: bool = True):
     raise ValueError(f"Ulysses requires num_heads ({H}) divisible by the "
                      f"seq axis size ({n})")
   if n > 1 and Env.get().config.sequence.ulysses_impl == "flash":
-    return _ulysses_flash(q, k, v, causal)
+    from easyparallellibrary_tpu.kernels.flash_attention import (
+        flash_blockable)
+    if flash_blockable(S):
+      return _ulysses_flash(q, k, v, causal)
+    # Length the kernels can't tile: the einsum formulation below has
+    # no blocking constraint — fall through instead of raising (the
+    # flash default must not regress lengths einsum always accepted).
 
   # all-to-all #1: seq-sharded -> head-sharded (full sequence locally).
   q = _constrain(q, HEAD_SHARDED)

@@ -254,3 +254,78 @@ def test_ulysses_einsum_impl_matches_flash(causal):
   np.testing.assert_allclose(out_f, out_e, rtol=2e-5, atol=2e-6)
   for a, b in zip(g_f, g_e):
     np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_zigzag_ring_matches_full(n):
+  """Zigzag causal layout: values match full attention exactly (the
+  layout exchange + balanced half-block schedule is numerics-neutral)."""
+  epl.init(epl.Config({"sequence.parallelism": "ring",
+                       "sequence.axis_size": n,
+                       "sequence.ring_layout": "zigzag"}))
+  epl.current_plan().build_mesh()
+  q, k, v = _qkv(S=32, seed=21)
+  out = jax.jit(lambda a, b, c: ring_attention(a, b, c, causal=True))(
+      q, k, v)
+  ref = _full_attention(q, k, v, causal=True)
+  np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_zigzag_ring_grads_match_full():
+  epl.init(epl.Config({"sequence.parallelism": "ring",
+                       "sequence.axis_size": 4,
+                       "sequence.ring_layout": "zigzag"}))
+  epl.current_plan().build_mesh()
+  q, k, v = _qkv(S=32, seed=23)
+
+  def loss_ring(q, k, v):
+    return jnp.mean(ring_attention(q, k, v, causal=True) ** 2)
+
+  def loss_full(q, k, v):
+    return jnp.mean(_full_attention(q, k, v, causal=True) ** 2)
+
+  g1 = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+  g2 = jax.jit(jax.grad(loss_full, argnums=(0, 1, 2)))(q, k, v)
+  for a, b in zip(g1, g2):
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_zigzag_noncausal_falls_back_to_contiguous():
+  """Zigzag is causal-only; non-causal rings use the contiguous path
+  (and still match full attention)."""
+  epl.init(epl.Config({"sequence.parallelism": "ring",
+                       "sequence.axis_size": 4,
+                       "sequence.ring_layout": "zigzag"}))
+  epl.current_plan().build_mesh()
+  q, k, v = _qkv(S=32, seed=25)
+  out = jax.jit(lambda a, b, c: ring_attention(a, b, c, causal=False))(
+      q, k, v)
+  ref = _full_attention(q, k, v, causal=False)
+  np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_unblockable_lengths_fall_back_to_einsum():
+  """Sequence lengths with no power-of-two block divisor (e.g. 1030 =
+  2*5*103 per device) must not raise or truncate: ring and Ulysses fall
+  back to their einsum formulations, which have no blocking constraint."""
+  from easyparallellibrary_tpu.kernels.flash_attention import (
+      flash_blockable)
+  assert not flash_blockable(515) and not flash_blockable(1030)
+  assert flash_blockable(512) and flash_blockable(96)
+
+  epl.init(epl.Config({"sequence.parallelism": "ring",
+                       "sequence.axis_size": 2,
+                       "sequence.ring_layout": "zigzag"}))
+  epl.current_plan().build_mesh()
+  # S=2060 -> per-device 1030 (even halves of 515, unblockable).
+  q, k, v = _qkv(S=2060, H=2, D=8, seed=27)
+  out = jax.jit(lambda a, b, c: ring_attention(a, b, c, causal=True))(
+      q, k, v)
+  ref = _full_attention(q, k, v, causal=True)
+  np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                             rtol=2e-5, atol=2e-6)
+
+  out_u = jax.jit(lambda a, b, c: ulysses_attention(a, b, c, causal=True))(
+      q, k, v)
+  np.testing.assert_allclose(np.asarray(out_u), np.asarray(ref),
+                             rtol=2e-5, atol=2e-6)
