@@ -1,0 +1,26 @@
+#!/bin/sh
+# MFU tuning sweep on the real chip: batch / remat policy / loss chunk.
+# Each run prints its label + bench.py's JSON line; stderr goes to
+# mfu_sweep.err so failures and batch-OOM fallbacks stay visible
+# (bench.py's JSON reports the batch actually measured).
+set -u
+ERRLOG="${TMPDIR:-/tmp}/mfu_sweep.err"
+: > "$ERRLOG"
+run() {
+  label="$1"; shift
+  echo "== $label"
+  # Command substitution (not a pipe) so bench.py's own exit status is
+  # what we test — `... | tail -1` would always report tail's 0.
+  out=$(env "$@" timeout 580 python bench.py 2>>"$ERRLOG")
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "FAILED (rc=$rc) — see $ERRLOG"
+  else
+    printf '%s\n' "$out" | tail -1
+  fi
+}
+run "batch24_default"      EPL_BENCH_BATCH=24
+run "batch20_default"      EPL_BENCH_BATCH=20
+run "remat_nothing"        EPL_BENCH_REMAT=nothing EPL_BENCH_BATCH=16,12,8
+run "losschunk512_b16"     EPL_BENCH_LOSS_CHUNK=512 EPL_BENCH_BATCH=16
+run "losschunk128_b16"     EPL_BENCH_LOSS_CHUNK=128 EPL_BENCH_BATCH=16

@@ -131,7 +131,7 @@ def _ring_fwd_pass(n, causal, q, k0, v0):
   from easyparallellibrary_tpu.kernels.flash_attention import (
       _default_block, _fwd)
   s = q.shape[2]
-  bq = bk = _default_block(s)
+  bq = bk = _default_block(s, d=q.shape[3])
   idx = jax.lax.axis_index(constants.SEQ_AXIS) if n > 1 else 0
   O = jnp.zeros(q.shape, jnp.float32)
   L = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
@@ -179,7 +179,7 @@ def _ring_local_bwd(n, causal, residuals, dO):
       _bwd_kernels, _default_block, _tile8)
   q, k0, v0, O, L = residuals
   s = q.shape[2]
-  bq = bk = _default_block(s)
+  bq = bk = _default_block(s, d=q.shape[3])
   idx = jax.lax.axis_index(constants.SEQ_AXIS) if n > 1 else 0
   dO = dO.astype(q.dtype)
   delta = jnp.sum(dO.astype(jnp.float32) * O.astype(jnp.float32), axis=-1)
@@ -281,7 +281,7 @@ def _zz_fwd_pass(n, q, k0, v0):
   from easyparallellibrary_tpu.kernels.flash_attention import (
       _default_block, _fwd)
   half = q.shape[2] // 2
-  bq = bk = _default_block(half)
+  bq = bk = _default_block(half, d=q.shape[3])
   idx = jax.lax.axis_index(constants.SEQ_AXIS)
   qa, qb = _halves(q)
 
@@ -317,10 +317,7 @@ def _zz_fwd_pass(n, q, k0, v0):
                         jnp.where(cond, NEG_INF, l_sl))
     o_r = jnp.concatenate([o_a, o_b], axis=2)
     lse_r = jnp.concatenate([l_a, l_b], axis=2)
-    L_new = jnp.logaddexp(L, lse_r)
-    O = (O * jnp.exp(L - L_new)[..., None]
-         + o_r * jnp.exp(lse_r - L_new)[..., None])
-    L = L_new
+    O, L = _merge(O, L, o_r, lse_r)
     if r != n - 1:
       k_cur, v_cur = _rot(k_cur, n), _rot(v_cur, n)
   return O, L
@@ -349,7 +346,7 @@ def _ring_local_zz_bwd(n, residuals, dO):
       _bwd_kernels, _default_block, _tile8)
   q, k0, v0, O, L = residuals
   half = q.shape[2] // 2
-  bq = bk = _default_block(half)
+  bq = bk = _default_block(half, d=q.shape[3])
   idx = jax.lax.axis_index(constants.SEQ_AXIS)
   dO = dO.astype(q.dtype)
   delta = jnp.sum(dO.astype(jnp.float32) * O.astype(jnp.float32), axis=-1)
@@ -432,7 +429,7 @@ def _ring_flash(q, k, v, causal: bool):
       flash_blockable)
   zigzag = (env.config.sequence.ring_layout == "zigzag" and causal
             and n > 1 and (S // n) % 2 == 0
-            and flash_blockable(S // n // 2))
+            and flash_blockable(S // n // 2, d=D))
 
   def local(q_l, k_l, v_l):
     qt = q_l.transpose(0, 2, 1, 3)
@@ -478,7 +475,7 @@ def ring_attention(q, k, v, causal: bool = True,
                        f"{axis} ring devices")
     from easyparallellibrary_tpu.kernels.flash_attention import (
         flash_blockable)
-    if flash_blockable(S // axis):
+    if flash_blockable(S // axis, d=D):
       return _ring_flash(q, k, v, causal)
     # Per-device block length the kernels can't tile (no power-of-two
     # divisor <= 512): fall through to the einsum formulation rather

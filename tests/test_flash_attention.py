@@ -58,6 +58,62 @@ def test_flash_grads_match(causal):
     np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_streaming_path_matches_full(causal, monkeypatch):
+  """Force the long-sequence streaming kernels (grid-streamed KV with
+  VMEM scratch accumulators) at test size and check against full
+  attention — the resident/streaming dispatch must be invisible."""
+  import importlib
+  fa_mod = importlib.import_module(
+      "easyparallellibrary_tpu.kernels.flash_attention")
+  monkeypatch.setattr(fa_mod, "_RESIDENT_MAX_ELEMS", 1)
+  q, k, v = _qkv(S=256, seed=4)
+  out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+  ref = _full_attention(q, k, v, causal=causal)
+  np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_streaming_grads_match(causal, monkeypatch):
+  import importlib
+  fa_mod = importlib.import_module(
+      "easyparallellibrary_tpu.kernels.flash_attention")
+  monkeypatch.setattr(fa_mod, "_RESIDENT_MAX_ELEMS", 1)
+  q, k, v = _qkv(S=128, seed=5)
+
+  def loss_flash(q, k, v):
+    return jnp.mean(flash_attention(q, k, v, causal=causal,
+                                    block_q=32, block_k=32) ** 2)
+
+  def loss_full(q, k, v):
+    return jnp.mean(_full_attention(q, k, v, causal=causal) ** 2)
+
+  g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+  g2 = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+  for a, b in zip(g1, g2):
+    np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5)
+
+
+def test_flash_streaming_uneven_blocks(monkeypatch):
+  """Streaming path with block_q != block_k exercises the causal
+  index-map clamps on both grids."""
+  import importlib
+  fa_mod = importlib.import_module(
+      "easyparallellibrary_tpu.kernels.flash_attention")
+  monkeypatch.setattr(fa_mod, "_RESIDENT_MAX_ELEMS", 1)
+  q, k, v = _qkv(S=256, seed=6)
+
+  def loss(attn):
+    return jax.grad(lambda a, b, c: jnp.mean(attn(a, b, c) ** 2),
+                    argnums=(0, 1, 2))(q, k, v)
+
+  g1 = loss(lambda a, b, c: flash_attention(a, b, c, causal=True,
+                                            block_q=32, block_k=64))
+  g2 = loss(lambda a, b, c: _full_attention(a, b, c, causal=True))
+  for a, b in zip(g1, g2):
+    np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-5)
+
+
 def test_flash_small_seq_single_block():
   q, k, v = _qkv(S=16, seed=3)
   out = flash_attention(q, k, v, causal=True)
