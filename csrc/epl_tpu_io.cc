@@ -92,10 +92,12 @@ int read_one_record(FILE* f, std::string* out) {
 
 class Reader {
  public:
-  Reader(std::vector<std::string> files, int num_threads, size_t prefetch)
+  Reader(std::vector<std::string> files, int num_threads, size_t prefetch,
+         uint64_t skip_records = 0)
       : files_(std::move(files)),
         queue_(prefetch == 0 ? 1 : prefetch),
-        num_threads_(num_threads < 1 ? 1 : num_threads) {
+        num_threads_(num_threads < 1 ? 1 : num_threads),
+        skip_(skip_records) {
     // Per-file staging queues: workers STREAM records into them (one
     // record in flight per read call), so resident memory is bounded by
     // queue capacities — never by file size.  Total bound:
@@ -205,6 +207,13 @@ class Reader {
       for (;;) {
         Record r;
         if (!file_queues_[i]->pop(&r) || r.eof) break;
+        // Resume support: drop the first `skip_` records of the
+        // deterministic stream (file order is fixed, so record index is
+        // a stable stream position across runs).
+        if (skip_ > 0) {
+          --skip_;
+          continue;
+        }
         queue_.push(std::move(r));
       }
     }
@@ -224,6 +233,7 @@ class Reader {
   std::mutex pos_mu_;
   std::condition_variable pos_cv_;
   std::atomic<bool> stop_{false};
+  uint64_t skip_ = 0;
   Record pending_;
   bool pending_valid_ = false;
 };
@@ -236,9 +246,13 @@ struct Writer {
 
 extern "C" {
 
-void* epl_reader_create(const char** files, int num_files,
-                        int shard_index, int num_shards,
-                        int num_threads, int prefetch_records) {
+// Like epl_reader_create, but the stream starts `skip_records` records
+// into this shard (checkpoint/resume of the input position).  Separate
+// symbol so a stale prebuilt library keeps working with older bindings.
+void* epl_reader_create_at(const char** files, int num_files,
+                           int shard_index, int num_shards,
+                           int num_threads, int prefetch_records,
+                           int64_t skip_records) {
   if (num_shards < 1) num_shards = 1;
   std::vector<std::string> mine;
   // Contiguous round-robin file→shard assignment (the reference slices
@@ -248,7 +262,16 @@ void* epl_reader_create(const char** files, int num_files,
   }
   return new Reader(std::move(mine), num_threads,
                     static_cast<size_t>(prefetch_records > 0
-                                        ? prefetch_records : 256));
+                                        ? prefetch_records : 256),
+                    skip_records > 0
+                        ? static_cast<uint64_t>(skip_records) : 0);
+}
+
+void* epl_reader_create(const char** files, int num_files,
+                        int shard_index, int num_shards,
+                        int num_threads, int prefetch_records) {
+  return epl_reader_create_at(files, num_files, shard_index, num_shards,
+                              num_threads, prefetch_records, 0);
 }
 
 int64_t epl_reader_next(void* reader, char* buf, int64_t cap) {

@@ -37,6 +37,13 @@ def _load_lib():
     lib.epl_reader_next.restype = ctypes.c_int64
     lib.epl_reader_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_int64]
+    # Newer library builds only (resume-at-position); probed at use time
+    # so a stale prebuilt .so still works.
+    if hasattr(lib, "epl_reader_create_at"):
+      lib.epl_reader_create_at.restype = ctypes.c_void_p
+      lib.epl_reader_create_at.argtypes = [
+          ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+          ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int64]
     lib.epl_reader_pending_size.restype = ctypes.c_int64
     lib.epl_reader_pending_size.argtypes = [ctypes.c_void_p]
     lib.epl_reader_destroy.argtypes = [ctypes.c_void_p]
@@ -79,7 +86,9 @@ def write_records(path: str, records: Sequence[bytes],
       f.write(rec)
 
 
-def _python_reader(files: List[str]) -> Iterator[bytes]:
+def _python_reader(files: List[str],
+                   skip_records: int = 0) -> Iterator[bytes]:
+  skip = skip_records
   for fname in files:
     with open(fname, "rb") as f:
       while True:
@@ -89,6 +98,11 @@ def _python_reader(files: List[str]) -> Iterator[bytes]:
         if len(header) != 8:
           raise IOError(f"truncated record header in {fname}")
         (length,) = struct.unpack("<Q", header)
+        if skip > 0:
+          # Resume: seek past skipped payloads without reading them.
+          f.seek(length, 1)
+          skip -= 1
+          continue
         payload = f.read(length)
         if len(payload) != length:
           raise IOError(f"truncated record in {fname}")
@@ -107,7 +121,8 @@ class RecordReader:
                num_shards: Optional[int] = None,
                num_threads: Optional[int] = None,
                prefetch_records: int = 256,
-               use_native: Optional[bool] = None):
+               use_native: Optional[bool] = None,
+               skip_records: int = 0):
     cfg = Env.get().config
     self.files = list(files)
     if num_shards is None:
@@ -124,6 +139,9 @@ class RecordReader:
     self.num_shards = max(1, num_shards)
     self.num_threads = num_threads or cfg.io.num_threads
     self.prefetch_records = prefetch_records
+    # Resume: start the deterministic stream this many records in (this
+    # shard's stream — record index is a stable position across runs).
+    self.skip_records = max(0, int(skip_records))
     lib = _load_lib()
     self._native = lib is not None if use_native is None else (
         bool(use_native) and lib is not None)
@@ -138,16 +156,23 @@ class RecordReader:
 
   def __iter__(self) -> Iterator[bytes]:
     if not self._native:
-      yield from _python_reader(self._shard())
+      yield from _python_reader(self._shard(), self.skip_records)
       return
     lib = self._lib
     # Slice in python (one policy for both paths), hand the native reader
     # the pre-sliced list as its single shard.
     mine = self._shard()
     c_files = (ctypes.c_char_p * len(mine))(*[f.encode() for f in mine])
-    handle = lib.epl_reader_create(
-        c_files, len(mine), 0, 1,
-        self.num_threads, self.prefetch_records)
+    skip = self.skip_records
+    if skip and hasattr(lib, "epl_reader_create_at"):
+      handle = lib.epl_reader_create_at(
+          c_files, len(mine), 0, 1,
+          self.num_threads, self.prefetch_records, skip)
+      skip = 0  # the library handles it
+    else:
+      handle = lib.epl_reader_create(
+          c_files, len(mine), 0, 1,
+          self.num_threads, self.prefetch_records)
     cap = 1 << 16
     buf = ctypes.create_string_buffer(cap)
     try:
@@ -159,6 +184,9 @@ class RecordReader:
           pending = lib.epl_reader_pending_size(handle)
           cap = max(pending, cap * 2)
           buf = ctypes.create_string_buffer(cap)
+          continue
+        if skip > 0:  # stale library without epl_reader_create_at
+          skip -= 1
           continue
         yield buf.raw[:n]
     finally:

@@ -24,6 +24,19 @@ from easyparallellibrary_tpu.runtime import saver
 from easyparallellibrary_tpu.utils.logging import get_logger
 
 
+def _accepts_start_step(factory: Callable) -> bool:
+  """Whether a data factory declares a `start_step` parameter (the
+  opt-in contract for resuming the input stream mid-epoch).  Only an
+  explicitly named parameter opts in — a bare ``**kwargs`` does not, so
+  pre-existing factories keep being called with no arguments."""
+  import inspect
+  try:
+    params = inspect.signature(factory).parameters
+  except (TypeError, ValueError):
+    return False
+  return "start_step" in params
+
+
 def fit(step_fn: Callable,
         state,
         data: Iterable[Any],
@@ -41,8 +54,18 @@ def fit(step_fn: Callable,
   `data` yields batches (already global/sharded — see io.DevicePrefetcher).
   For more steps than one pass of `data`, pass a re-iterable (a list, or a
   zero-arg factory returning a fresh iterator) — one-shot iterators cannot
-  be rewound.  The rng is folded with the step index each step, so
-  stochastic layers (dropout) get fresh randomness.
+  be rewound.  A factory may instead accept a `start_step` keyword: fit
+  then calls `data(start_step=N)` when resuming from a checkpoint at step
+  N (and `start_step=0` on epoch restarts), so the factory can resume the
+  INPUT stream mid-epoch too — e.g. by passing
+  ``RecordReader(..., skip_records=(N * records_per_step) % shard_records)``
+  (the modulo matters: an interrupted run that already wrapped an epoch
+  must not skip past the end of the stream — fit restarts epochs exactly
+  at exhaustion, so the in-epoch offset is the full position).  This is
+  the input-position half of checkpoint/resume; the reference gets it
+  from TF's dataset checkpointing.  The rng is folded with the step index
+  each
+  step, so stochastic layers (dropout) get fresh randomness.
   Returns (state, last_metrics).
   """
   log = get_logger()
@@ -83,7 +106,14 @@ def fit(step_fn: Callable,
     except ValueError:  # not the main thread
       prev_handler = None
 
-  it = iter(data() if callable(data) else data)
+  def _make_iter(at_step: int):
+    if not callable(data):
+      return iter(data)
+    if _accepts_start_step(data):
+      return iter(data(start_step=at_step))
+    return iter(data())
+
+  it = _make_iter(start_step)
   metrics: Dict[str, Any] = {}
   for step_idx in range(start_step, num_steps):
     if preempted["flag"]:
@@ -97,7 +127,18 @@ def fit(step_fn: Callable,
     try:
       batch = next(it)
     except StopIteration:
-      it = iter(data() if callable(data) else data)
+      if step_idx == start_step and start_step > 0:
+        # The resumed stream produced nothing: almost always a
+        # skip_records that overran the shard (missing the modulo in the
+        # recipe above) — restarting at record 0 would silently train on
+        # a different data order than the uninterrupted run.
+        log.warning(
+            "data factory resumed at start_step=%d yielded no batches; "
+            "restarting the stream from its beginning.  If the factory "
+            "skips records, skip (start_step * records_per_step) MODULO "
+            "the shard's record count.", start_step)
+      # Epoch boundary: restart the stream from its beginning.
+      it = _make_iter(0)
       try:
         batch = next(it)
       except StopIteration:

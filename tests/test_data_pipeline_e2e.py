@@ -90,3 +90,17 @@ def test_prefetcher_depth_and_order(tmp_path):
   pre2 = DevicePrefetcher(iter(batches), mesh, depth=1)
   first = next(iter(pre2))
   assert "data" in str(first["ids"].sharding.spec)
+
+
+def test_reader_skip_records_matches_slice(tmp_path):
+  """skip_records=N yields exactly full_stream[N:] — the input-position
+  resume contract — on both the native and python readers."""
+  seq = 16
+  files = _write_token_files(tmp_path, n_files=3, recs_per_file=5, seq=seq)
+  full = list(RecordReader(files, use_native=False))
+  assert len(full) == 15
+  for use_native in ([True, False] if native_io_available() else [False]):
+    for skip in (0, 1, 7, 14, 15, 20):
+      got = list(RecordReader(files, use_native=use_native,
+                              skip_records=skip))
+      assert got == full[skip:], (use_native, skip)

@@ -171,3 +171,41 @@ def test_fit_exhausted_iterator_raises_clear_error():
   one_shot = iter([batch, batch])
   with np.testing.assert_raises(RuntimeError):
     fit(step, state, one_shot, num_steps=5, log_every=0)
+
+
+def test_fit_resume_passes_start_step_to_data_factory(tmp_path):
+  """Resuming from a checkpoint at step N must hand the data factory
+  start_step=N (mid-epoch input-position resume); epoch restarts within
+  a run hand it start_step=0."""
+  state, shardings, step, batch = _setup()
+  ckpt = str(tmp_path / "ck")
+  calls = []
+
+  def factory(start_step=0):
+    calls.append(start_step)
+    return [batch, batch]          # 2 batches per "epoch"
+
+  state, _ = fit(step, state, factory, num_steps=5, checkpoint_dir=ckpt,
+                 checkpoint_every=5, log_every=0, shardings=shardings)
+  # First iterator at step 0, then epoch restarts at steps 2 and 4.
+  assert calls == [0, 0, 0]
+
+  state2, shardings2, step2, _ = _setup()
+  calls.clear()
+  state2, _ = fit(step2, state2, factory, num_steps=7, checkpoint_dir=ckpt,
+                  log_every=0, shardings=shardings2)
+  # Resumed at step 5 → factory told to start there; the following epoch
+  # restart goes back to 0.
+  assert calls[0] == 5
+  assert all(c == 0 for c in calls[1:])
+  assert int(state2.step) == 7
+
+
+def test_fit_plain_factory_still_works():
+  state, _, step, batch = _setup()
+
+  def factory():
+    return [batch]
+
+  state, metrics = fit(step, state, factory, num_steps=3, log_every=0)
+  assert int(state.step) == 3
