@@ -289,8 +289,8 @@ def _check_blocks(S, Skv, bq, bk):
   # silently drop the tail (wrong attention, no error) — refuse instead.
   if S % bq or Skv % bk:
     raise ValueError(
-        f"sequence lengths (q={S}, kv={Skv}) must divide block sizes "
-        f"({bq}, {bk})")
+        f"block sizes ({bq}, {bk}) must divide the sequence lengths "
+        f"(q={S}, kv={Skv})")
 
 
 def _fwd(q, k, v, causal: bool, block_q: int, block_k: int):
@@ -639,7 +639,7 @@ def flash_attention_lse(q, k, v, causal: bool = True,
   bq = min(block_q, S) if block_q else _default_block(S, d=D)
   bk = min(block_k, S) if block_k else _default_block(S, d=D)
   if not bq or not bk or S % bq or S % bk:
-    raise ValueError(f"seq len {S} must divide block sizes ({bq}, {bk})")
+    raise ValueError(f"block sizes ({bq}, {bk}) must divide seq len {S}")
   qt = q.transpose(0, 2, 1, 3)
   kt = k.transpose(0, 2, 1, 3)
   vt = v.transpose(0, 2, 1, 3)
@@ -693,7 +693,7 @@ def flash_attention(q, k, v, causal: bool = True,
   bq = min(block_q, S) if block_q else _default_block(S, d=D)
   bk = min(block_k, S) if block_k else _default_block(S, d=D)
   if not bq or not bk or S % bq or S % bk:
-    raise ValueError(f"seq len {S} must divide block sizes ({bq}, {bk})")
+    raise ValueError(f"block sizes ({bq}, {bk}) must divide seq len {S}")
   # Kernels use [B, H, S, D] layout.
   qt = q.transpose(0, 2, 1, 3)
   kt = k.transpose(0, 2, 1, 3)

@@ -505,7 +505,7 @@ def gpt_loss(model: GPT, params, batch, rng=None):
 
 
 def make_gpt_1f1b_grad_fn(model: GPT):
-  """Interleaved-1F1B gradient function for a pipelined GPT.
+  """1F1B gradient function for a pipelined GPT.
 
   Maps the GPT parameter tree onto the generic 1F1B engine
   (parallel/schedule_1f1b.py): embedding = feed, stacked transformer
@@ -683,7 +683,7 @@ def make_gpt_train_step(model: GPT, config=None):
   """Config-driven train step for GPT, schedule-aware.
 
   Under ``PreferBackward``/``PreferBackwardOptimizer`` with pipeline
-  stages, gradients come from the true interleaved 1F1B engine
+  stages, gradients come from the true 1F1B engine
   (reference: epl/strategies/scheduler.py:53-116 orders backward-k before
   forward-k+1 — here the interleave is explicit in one scan); otherwise
   the standard autodiff path (`build_train_step` over :func:`gpt_loss`).
@@ -724,21 +724,66 @@ def make_gpt_train_step(model: GPT, config=None):
                           config=conf, num_apply_group=groups)
 
 
+def sample_logits(logits, rng, temperature: float = 1.0,
+                  top_k: int = 0, top_p: float = 1.0):
+  """Sample token ids from ``[..., vocab]`` logits.
+
+  ``temperature<=0`` is greedy; ``top_k>0`` restricts to the k highest
+  logits; ``top_p<1`` restricts to the smallest set whose probability
+  mass reaches p (nucleus sampling; the top token always survives).
+  Filters compose (top-k first, then top-p over the survivors), all with
+  static shapes, so this is jit/fori_loop-safe and usable on sharded
+  logits.
+  """
+  # Validate here (not only in generate): top_p=0 would otherwise mask
+  # EVERY logit to -1e30 and categorical would sample uniformly over the
+  # whole vocabulary — garbage tokens with no error.
+  if not 0.0 < top_p <= 1.0:
+    raise ValueError(f"top_p must be in (0, 1]: {top_p}")
+  if top_k < 0:
+    raise ValueError(f"top_k must be >= 0: {top_k}")
+  if temperature <= 0:
+    return jnp.argmax(logits, axis=-1)
+  logits = logits / temperature
+  neg = jnp.asarray(-1e30, logits.dtype)
+  if top_k and top_k < logits.shape[-1]:
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    logits = jnp.where(logits < kth, neg, logits)
+  if top_p < 1.0:
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep entries whose PRECEDING mass is < p (so the first token that
+    # crosses p is still kept, and the top token always survives).
+    keep_sorted = (cum - probs) < top_p
+    # Threshold = smallest kept logit; everything below is cut.
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    logits = jnp.where(logits < thresh.astype(logits.dtype), neg, logits)
+  return jax.random.categorical(rng, logits, axis=-1)
+
+
 def generate(model: GPT, params, prompt_ids, max_new_tokens: int,
-             temperature: float = 0.0, rng=None, use_cache: bool = True):
+             temperature: float = 0.0, rng=None, use_cache: bool = True,
+             top_k: int = 0, top_p: float = 1.0):
   """Autoregressive decoding; returns [B, prompt + max_new_tokens].
 
   With ``use_cache`` (default), each layer keeps a K/V cache: one prefill
   over the prompt, then O(1) forwards per generated token (VERDICT
   round-1 item 10).  ``use_cache=False`` (or a pipelined config) falls
   back to re-running the full forward per token — the simple path the
-  cached one is tested against.  ``temperature=0`` is greedy.
+  cached one is tested against.  ``temperature=0`` is greedy;
+  ``top_k``/``top_p`` restrict sampling (see :func:`sample_logits`).
   """
   B, plen = prompt_ids.shape
   if plen == 0:
     raise ValueError("generate() needs a non-empty prompt (at least a BOS "
                      "token); an empty prompt would condition the first "
                      "token on uninitialized padding")
+  if not 0.0 < top_p <= 1.0:
+    raise ValueError(f"top_p must be in (0, 1]: {top_p}")
+  if top_k < 0:
+    raise ValueError(f"top_k must be >= 0: {top_k}")
   total = plen + max_new_tokens
   if total > model.cfg.max_seq_len:
     raise ValueError(f"prompt + new tokens ({total}) exceeds "
@@ -747,11 +792,8 @@ def generate(model: GPT, params, prompt_ids, max_new_tokens: int,
   rng = rng if rng is not None else jax.random.PRNGKey(0)
 
   def pick(next_logits, t):
-    if temperature > 0:
-      step_rng = jax.random.fold_in(rng, t)
-      return jax.random.categorical(
-          step_rng, next_logits / temperature, axis=-1)
-    return jnp.argmax(next_logits, axis=-1)
+    return sample_logits(next_logits, jax.random.fold_in(rng, t),
+                         temperature, top_k, top_p)
 
   if max_new_tokens <= 0:
     return ids

@@ -88,7 +88,7 @@ def one_f_one_b(feed_fn: Callable,
                 stage_aux_weight: float = 0.0,
                 seq_parallel: bool = False,
                 stage_extra: Optional[tuple] = None) -> Callable:
-  """Build an interleaved-1F1B pipeline gradient function.
+  """Build a 1F1B pipeline gradient function.
 
   Contracts (all pure functions; `rng` may be None throughout):
 

@@ -11,7 +11,7 @@ Here the policies select between two genuinely different programs:
   * PreferForward          — GPipe: autodiff through the SPMD pipeline
                              (parallel/pipeline.py); all micro-batch
                              activations live at the fwd/bwd boundary.
-  * PreferBackward         — TRUE interleaved 1F1B: the manual
+  * PreferBackward         — TRUE 1F1B: the manual
                              fwd/bwd-wavefront scan in
                              parallel/schedule_1f1b.py, whose residual
                              ring structurally bounds live stage inputs to

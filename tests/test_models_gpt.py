@@ -218,3 +218,51 @@ def test_generate_kv_cache_matches_full_forward():
   # max_new_tokens=0 returns the prompt untouched on both paths.
   np.testing.assert_array_equal(
       np.asarray(generate(model, params, prompt, 0)), np.asarray(prompt))
+
+
+def test_sample_logits_top_k_top_p():
+  from easyparallellibrary_tpu.models.gpt import sample_logits
+  rng = jax.random.PRNGKey(0)
+  logits = jnp.asarray(np.random.RandomState(0).randn(64, 32), jnp.float32)
+  greedy = jnp.argmax(logits, axis=-1)
+
+  # temperature<=0 is greedy regardless of filters.
+  np.testing.assert_array_equal(
+      sample_logits(logits, rng, temperature=0.0, top_k=5), greedy)
+  # top_k=1 collapses sampling to greedy at any temperature.
+  np.testing.assert_array_equal(
+      sample_logits(logits, rng, temperature=2.0, top_k=1), greedy)
+  # tiny top_p keeps only the top token.
+  np.testing.assert_array_equal(
+      sample_logits(logits, rng, temperature=1.5, top_p=1e-6), greedy)
+  # top_k=k: every sample lies inside the per-row top-k set.
+  k = 4
+  topk_sets = jax.lax.top_k(logits, k)[1]
+  for seed in range(3):
+    s = sample_logits(logits, jax.random.PRNGKey(seed), temperature=1.0,
+                      top_k=k)
+    assert bool(jnp.all(jnp.any(topk_sets == s[:, None], axis=-1)))
+  # top_p=0.5 restricts support vs unfiltered sampling but stays valid.
+  s = sample_logits(logits, rng, temperature=1.0, top_p=0.5)
+  assert s.shape == (64,) and bool(jnp.all((s >= 0) & (s < 32)))
+
+
+def test_generate_top_k_top_p_paths():
+  from easyparallellibrary_tpu.models.gpt import generate
+  epl.init()
+  model = GPT(TINY)
+  prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+  params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+  out = generate(model, params, prompt, 5, temperature=1.0, top_k=3,
+                 top_p=0.9, rng=jax.random.PRNGKey(1))
+  assert out.shape == (1, 8)
+  # top_k=1 sampling equals greedy decoding.
+  out_k1 = generate(model, params, prompt, 5, temperature=1.0, top_k=1,
+                    rng=jax.random.PRNGKey(2))
+  out_greedy = generate(model, params, prompt, 5)
+  np.testing.assert_array_equal(out_k1, out_greedy)
+  import pytest
+  with pytest.raises(ValueError, match="top_p"):
+    generate(model, params, prompt, 2, top_p=0.0)
+  with pytest.raises(ValueError, match="top_k"):
+    generate(model, params, prompt, 2, top_k=-1)
