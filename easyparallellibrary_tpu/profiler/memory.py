@@ -84,3 +84,47 @@ class MemoryProfiler:
       writer.writeheader()
       writer.writerows(self.records)
     get_logger().info("memory profile written to %s", path)
+
+  def dump_png(self, path: str,
+               phase_spans: Optional[List[tuple]] = None):
+    """Plot the per-device HBM timeline (reference parity: its
+    MemoryProfilerHook renders the allocation timeline with phases
+    shaded, memory_profiler_hook.py:207-271).
+
+    `phase_spans`: optional [(start_step, end_step, label), ...] shaded
+    behind the curves — e.g. warmup/steady/eval regions the caller
+    tracked.  No-op (with a log line) when matplotlib is unavailable or
+    nothing was recorded.
+    """
+    if not self.records:
+      get_logger().info("memory profile: nothing recorded, skipping %s",
+                        path)
+      return
+    try:
+      import matplotlib
+      matplotlib.use("Agg")
+      import matplotlib.pyplot as plt
+    except ImportError:
+      get_logger().info("matplotlib unavailable; wrote no PNG (use "
+                        "dump_csv)")
+      return
+    steps = [r["step"] for r in self.records]
+    fig, ax = plt.subplots(figsize=(8, 4))
+    dev_keys = sorted({k.split("_")[0] for r in self.records
+                       for k in r if k.startswith("dev")})
+    for dk in dev_keys:
+      in_use = [r.get(f"{dk}_bytes_in_use", 0) / 2**30
+                for r in self.records]
+      peak = [r.get(f"{dk}_peak_bytes", 0) / 2**30 for r in self.records]
+      ax.plot(steps, in_use, label=f"{dk} in use")
+      ax.plot(steps, peak, linestyle="--", label=f"{dk} peak")
+    for start, end, label in phase_spans or ():
+      ax.axvspan(start, end, alpha=0.12, label=label)
+    ax.set_xlabel("step")
+    ax.set_ylabel("HBM (GiB)")
+    ax.legend(loc="upper left", fontsize=7)
+    ax.set_title("device memory timeline")
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    get_logger().info("memory timeline PNG written to %s", path)

@@ -1,0 +1,68 @@
+"""Autoregressive decoding example — KV cache + sampling controls.
+
+  python examples/generate_gpt.py                     # greedy
+  python examples/generate_gpt.py --temperature 0.8 --top-k 40
+  python examples/generate_gpt.py --temperature 0.9 --top-p 0.95
+
+Loads a checkpoint if --checkpoint-dir has one (e.g. from
+examples/train_gpt.py), otherwise decodes from random init — the point
+here is the decode path: one prefill over the prompt populates each
+layer's K/V cache, then O(1) forwards per generated token
+(models/gpt.py generate; the reference has no serving story — its model
+zoo lives in the external FastNN repo, /root/reference/README.md:18).
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.models import GPT, GPTConfig
+from easyparallellibrary_tpu.models.gpt import generate
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--temperature", type=float, default=0.0)
+  ap.add_argument("--top-k", type=int, default=0)
+  ap.add_argument("--top-p", type=float, default=1.0)
+  ap.add_argument("--max-new-tokens", type=int, default=32)
+  ap.add_argument("--checkpoint-dir", default="")
+  ap.add_argument("--seed", type=int, default=0)
+  # Model shape flags mirror examples/train_gpt.py so a checkpoint from
+  # there loads here unchanged.
+  ap.add_argument("--layers", type=int, default=4)
+  ap.add_argument("--d-model", type=int, default=256)
+  args = ap.parse_args()
+
+  epl.init()
+  cfg = GPTConfig(vocab_size=4096, num_layers=args.layers, num_heads=8,
+                  d_model=args.d_model, d_ff=4 * args.d_model,
+                  max_seq_len=256, dtype=jnp.float32)
+  model = GPT(cfg)
+  prompt = jnp.asarray(
+      np.random.RandomState(args.seed).randint(0, cfg.vocab_size, (1, 8)),
+      jnp.int32)
+  params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+  if args.checkpoint_dir:
+    from easyparallellibrary_tpu.runtime.saver import (
+        latest_step, restore_checkpoint)
+    if latest_step(args.checkpoint_dir) is not None:
+      # train_gpt.py saves the bare params tree — restore with the same
+      # structure (wrapping in {"params": ...} would prefix every leaf
+      # name and miss the checkpoint's tensors).
+      params, step = restore_checkpoint(args.checkpoint_dir, target=params)
+      print(f"restored checkpoint at step {step}")
+
+  out = generate(model, params, prompt, args.max_new_tokens,
+                 temperature=args.temperature, top_k=args.top_k,
+                 top_p=args.top_p, rng=jax.random.PRNGKey(args.seed))
+  print("prompt:   ", np.asarray(prompt[0]).tolist())
+  print("generated:", np.asarray(out[0, prompt.shape[1]:]).tolist())
+
+
+if __name__ == "__main__":
+  main()

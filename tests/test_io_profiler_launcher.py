@@ -265,3 +265,29 @@ def test_launcher_retry_on_failure(tmp_path):
   script.write_text("import sys; sys.exit(3)\n")
   code = launch_local(1, [sys.executable, str(script)], retries=1)
   assert code == 1
+
+
+def test_memory_profiler_records_csv_png(tmp_path):
+  pytest.importorskip("matplotlib")   # optional dep: dump_png degrades
+  from easyparallellibrary_tpu.profiler import MemoryProfiler
+  prof = MemoryProfiler(every_n_steps=2)
+  x = jnp.ones((64, 64))
+  for _ in range(6):
+    x = (x @ x) / 64.0
+    prof.step()
+  assert len(prof.records) == 3          # steps 2, 4, 6
+  assert prof.peak_bytes() >= 0.0
+  csv_path = str(tmp_path / "mem.csv")
+  prof.dump_csv(csv_path)
+  assert os.path.getsize(csv_path) > 0
+  png_path = str(tmp_path / "mem.png")
+  prof.dump_png(png_path, phase_spans=[(2, 4, "warmup")])
+  assert os.path.exists(png_path) and os.path.getsize(png_path) > 0
+
+
+def test_memory_profiler_empty_png_is_noop(tmp_path):
+  from easyparallellibrary_tpu.profiler import MemoryProfiler
+  prof = MemoryProfiler(every_n_steps=1)
+  png_path = str(tmp_path / "none.png")
+  prof.dump_png(png_path)
+  assert not os.path.exists(png_path)
