@@ -38,6 +38,7 @@ class BertConfig:
   param_dtype: Any = jnp.float32
   tensor_parallel: bool = False
   remat: bool = False
+  attn_impl: str = "xla"             # xla | pallas_flash (non-causal)
   pipeline_stages: int = 1
   num_micro_batch: int = 1
   pipeline_schedule: str = ""   # "" = from Config pipeline.strategy
@@ -71,10 +72,23 @@ class EncoderBlock(nn.Module):
     qkv = _constrain(qkv, P(constants.DATA_AXIS, None, None,
                             constants.MODEL_AXIS, None))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    scale = 1.0 / jnp.sqrt(D // H).astype(cfg.dtype)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(cfg.dtype)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, D)
+    if cfg.attn_impl == "pallas_flash":
+      # Bidirectional flash (causal=False) — same kernel as GPT's path;
+      # removes the [B, H, S, S] score temps at BERT's S=512 default.
+      from easyparallellibrary_tpu.kernels.flash_attention import (
+          flash_attention)
+      attn = flash_attention(q, k, v, causal=False).reshape(B, S, D)
+    elif cfg.attn_impl == "xla":
+      scale = 1.0 / jnp.sqrt(D // H).astype(cfg.dtype)
+      logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+      probs = jax.nn.softmax(logits.astype(jnp.float32),
+                             -1).astype(cfg.dtype)
+      attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, D)
+    else:
+      # A typo'd impl silently falling back to dense attention would
+      # mislabel any benchmark run on top of it (same guard as GPT).
+      raise ValueError(f"attn_impl must be 'xla' or 'pallas_flash'; "
+                       f"got {cfg.attn_impl!r}")
     x = x + Dense(D, parallel=row, dtype=cfg.dtype,
                   param_dtype=cfg.param_dtype, name="proj")(attn)
 

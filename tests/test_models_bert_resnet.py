@@ -207,3 +207,27 @@ def test_resnet_unknown_norm_raises():
   x = jnp.zeros((1, 16, 16, 3), jnp.float32)
   with pytest.raises(ValueError, match="norm"):
     model.init(jax.random.PRNGKey(0), x)
+
+
+def test_bert_flash_attention_matches_xla():
+  epl.init()
+  base = dict(vocab_size=256, num_layers=2, num_heads=4, d_model=64,
+              d_ff=128, max_seq_len=32, dtype=jnp.float32)
+  flash = Bert(BertConfig(**base, attn_impl="pallas_flash"))
+  xla = Bert(BertConfig(**base, attn_impl="xla"))
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 32)),
+                    jnp.int32)
+  params = flash.init(jax.random.PRNGKey(0), ids)["params"]
+  out_f = flash.apply({"params": params}, ids)
+  out_x = xla.apply({"params": params}, ids)
+  np.testing.assert_allclose(out_f, out_x, rtol=2e-4, atol=2e-5)
+
+
+def test_bert_unknown_attn_impl_raises():
+  epl.init()
+  model = Bert(BertConfig(vocab_size=64, num_layers=1, num_heads=2,
+                          d_model=16, d_ff=32, max_seq_len=16,
+                          attn_impl="flash"))
+  ids = jnp.zeros((1, 16), jnp.int32)
+  with pytest.raises(ValueError, match="attn_impl"):
+    model.init(jax.random.PRNGKey(0), ids)
