@@ -527,8 +527,18 @@ def make_gpt_1f1b_grad_fn(model: GPT):
   if cfg.pipeline_stages <= 1:
     raise ValueError("1F1B needs pipeline_stages > 1")
   if cfg.pipeline_interleave > 1:
-    raise ValueError("1F1B with pipeline_interleave > 1 (interleaved "
-                     "schedule) is not supported yet; use interleave=1")
+    # Deliberately unsupported, not a TODO: in this engine's lockstep
+    # SPMD wavefront every tick costs a full device-share of compute
+    # (masked chunks execute anyway), so a K-way chunk-interleaved chain
+    # has ramp 2(S*K-1) chunk-ticks ~= 2(S - 1/K) device-ticks — never
+    # better than plain 1F1B's 2(S-1).  Megatron's interleave win needs
+    # per-rank asynchronous schedules the uniform-program formulation
+    # cannot express.  See strategies/scheduler.py.
+    raise ValueError(
+        "1F1B with pipeline_interleave > 1 is not supported: chunk "
+        "interleaving cannot beat plain 1F1B under this engine's "
+        "lockstep SPMD schedule (see strategies/scheduler.py); use "
+        "interleave=1, or PreferForward for circular weight placement")
   S, M = cfg.pipeline_stages, cfg.num_micro_batch
   blocks_per_stage, n_active = stage_layout(cfg.num_layers, S,
                                             cfg.stage_plan)
@@ -835,10 +845,14 @@ def gpt_flops_per_token(cfg: GPTConfig, seq_len: Optional[int] = None) -> float:
   """Training FLOPs/token (fwd+bwd ≈ 3x fwd): 6*N_dense + attention term."""
   S = seq_len or cfg.max_seq_len
   D, F, L, V = cfg.d_model, cfg.d_ff, cfg.num_layers, cfg.vocab_size
-  per_layer = 4 * D * D + 2 * D * F   # qkv+proj, mlp in+out (matmul weights)
-  if cfg.num_experts > 0:
-    # MoE layers activate one expert per token (top-1) — same matmul count.
-    pass
-  n_matmul = L * per_layer + D * V    # + lm head
+  attn_part = 4 * D * D               # qkv + proj
+  ffn_part = 2 * D * F                # mlp in + out
+  n_matmul = L * (attn_part + ffn_part) + D * V   # + lm head
+  if cfg.num_experts > 0 and cfg.moe_top_k > 1:
+    # Top-k>1 routes each token through k experts: the FFN matmuls of
+    # the MoE blocks (every moe_every-th) run k times per token.
+    n_moe_blocks = len([i for i in range(L)
+                        if (i + 1) % max(cfg.moe_every, 1) == 0])
+    n_matmul += n_moe_blocks * ffn_part * (cfg.moe_top_k - 1)
   attn = L * 2 * D * S                # qk^T and attn*v per token
   return 6.0 * n_matmul + 6.0 * attn

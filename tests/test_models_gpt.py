@@ -266,3 +266,15 @@ def test_generate_top_k_top_p_paths():
     generate(model, params, prompt, 2, top_p=0.0)
   with pytest.raises(ValueError, match="top_k"):
     generate(model, params, prompt, 2, top_k=-1)
+
+
+def test_moe_flops_accounts_for_top_k():
+  from easyparallellibrary_tpu.models.gpt import gpt_flops_per_token
+  base = dict(vocab_size=256, num_layers=4, num_heads=4, d_model=64,
+              d_ff=256, max_seq_len=32)
+  dense = gpt_flops_per_token(GPTConfig(**base))
+  top1 = gpt_flops_per_token(GPTConfig(**base, num_experts=4, moe_top_k=1))
+  top2 = gpt_flops_per_token(GPTConfig(**base, num_experts=4, moe_top_k=2))
+  assert top1 == dense          # top-1 activates the same matmul count
+  # moe_every=2 over 4 layers -> 2 MoE blocks; each adds one extra FFN.
+  assert top2 == dense + 6.0 * 2 * (2 * 64 * 256)
