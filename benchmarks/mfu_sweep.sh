@@ -4,6 +4,7 @@
 # mfu_sweep.err so failures and batch-OOM fallbacks stay visible
 # (bench.py's JSON reports the batch actually measured).
 set -u
+cd "$(dirname "$0")/.."  # bench.py lives at the repo root
 ERRLOG="${TMPDIR:-/tmp}/mfu_sweep.err"
 : > "$ERRLOG"
 run() {

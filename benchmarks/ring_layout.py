@@ -1,0 +1,134 @@
+"""Measured delta: contiguous vs zigzag causal ring-attention layout.
+
+Two measurements (VERDICT r2 item 6):
+
+* **mesh mode** (default; forced 8-device CPU mesh): end-to-end
+  forward+backward wall-clock of the flash ring program under both
+  ``sequence.ring_layout`` settings.  CPU pallas runs in interpret
+  mode, so absolute times are meaningless but the *ratio* tracks the
+  number of block computations each layout schedules — the quantity the
+  zigzag layout exists to halve.
+* **--chip mode** (real TPU): per-ring-step critical-path kernel time.
+  Contiguous: the slowest device computes one full s x s cross-block
+  attention per step.  Zigzag: every device computes two half x half
+  blocks (one causal on step 0).  Times the actual Pallas kernels at
+  those shapes on the chip.
+
+Usage:
+  python benchmarks/ring_layout.py          # mesh mode (CPU)
+  python benchmarks/ring_layout.py --chip   # kernel mode (TPU)
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def mesh_mode():
+  os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                             " --xla_force_host_platform_device_count=8")
+  import jax
+  jax.config.update("jax_platforms", "cpu")
+  import jax.numpy as jnp
+  import numpy as np
+  import easyparallellibrary_tpu as epl
+  from easyparallellibrary_tpu.sequence import ring_attention
+
+  B, H, S, D, n = 1, 4, 2048, 64, 8
+  rng = np.random.RandomState(0)
+  q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+  k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+  v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+
+  results = {}
+  for layout in ("contiguous", "zigzag"):
+    epl.init(epl.Config({"sequence.parallelism": "ring",
+                         "sequence.axis_size": n,
+                         "sequence.ring_layout": layout}))
+    mesh = epl.current_plan().build_mesh()
+    assert mesh.shape.get("seq", 1) == n, mesh.shape
+
+    def loss(q, k, v):
+      return jnp.sum(ring_attention(q, k, v, causal=True) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    out = g(q, k, v)  # compile + first run
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(3):
+      out = g(q, k, v)
+    jax.block_until_ready(out)
+    results[layout] = (time.perf_counter() - t0) / 3
+
+  ratio = results["contiguous"] / results["zigzag"]
+  print(json.dumps({
+      "mode": "mesh", "shape": {"B": B, "H": H, "S": S, "D": D, "n": n},
+      "contiguous_s": round(results["contiguous"], 3),
+      "zigzag_s": round(results["zigzag"], 3),
+      "speedup": round(ratio, 3)}))
+
+
+def chip_mode():
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+  from benchmarks._common import force, null_round_trip
+  from easyparallellibrary_tpu.kernels.flash_attention import _fwd
+
+  # Per-device block length s = S/n for a representative long-context
+  # shard: S=32k over n=8.
+  B, H, s, D = 1, 16, 4096, 64
+  rng = np.random.RandomState(0)
+  mk = lambda: jnp.asarray(rng.randn(B, H, s, D), jnp.bfloat16)
+  q, k, v = mk(), mk(), mk()
+  qh, kh, vh = q[:, :, :s // 2], k[:, :, :s // 2], v[:, :, :s // 2]
+
+  null = null_round_trip()
+
+  def timeit(fn, *args, reps=10):
+    force(fn(*args)[0])  # warm
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(reps):
+      r = fn(*args)
+    force(r[0])
+    return max(time.perf_counter() - t0 - null, 1e-9) / reps
+
+  # One jit serves both shapes (jit specializes per input shape).
+  fwd = jax.jit(functools.partial(_fwd, causal=False,
+                                  block_q=512, block_k=512))
+  half_causal = jax.jit(functools.partial(_fwd, causal=True,
+                                          block_q=512, block_k=512))
+
+  t_full = timeit(fwd, q, k, v)
+  t_half = timeit(fwd, qh, kh, vh)
+  t_half_causal = timeit(half_causal, qh, kh, vh)
+
+  # Contiguous critical path per ring step: one full s x s block.
+  # Zigzag: two half-blocks (the causal one only on step 0; use the
+  # steady-state non-causal pair).
+  contiguous_step = t_full
+  zigzag_step = 2 * t_half
+  print(json.dumps({
+      "mode": "chip", "shape": {"B": B, "H": H, "s": s, "D": D},
+      "device": jax.devices()[0].device_kind,
+      "full_block_ms": round(1e3 * t_full, 3),
+      "half_block_ms": round(1e3 * t_half, 3),
+      "half_block_causal_ms": round(1e3 * t_half_causal, 3),
+      "contiguous_step_ms": round(1e3 * contiguous_step, 3),
+      "zigzag_step_ms": round(1e3 * zigzag_step, 3),
+      "per_step_speedup": round(contiguous_step / zigzag_step, 3)}))
+
+
+if __name__ == "__main__":
+  if "--chip" in sys.argv:
+    chip_mode()
+  else:
+    mesh_mode()

@@ -91,6 +91,7 @@ def _python_reader(files: List[str],
   skip = skip_records
   for fname in files:
     with open(fname, "rb") as f:
+      size = os.fstat(f.fileno()).st_size
       while True:
         header = f.read(8)
         if not header:
@@ -100,13 +101,22 @@ def _python_reader(files: List[str],
         (length,) = struct.unpack("<Q", header)
         if skip > 0:
           # Resume: seek past skipped payloads without reading them.
+          # Seeking never fails past EOF, so a truncated payload must
+          # be detected by position — same IOError the read path raises.
           f.seek(length, 1)
+          if f.tell() > size:
+            raise IOError(f"truncated record in {fname}")
           skip -= 1
           continue
         payload = f.read(length)
         if len(payload) != length:
           raise IOError(f"truncated record in {fname}")
         yield payload
+  if skip > 0:
+    get_logger().warning(
+        "skip_records exhausted the input: %d records remained to skip "
+        "after reading all %d files (resume offset beyond dataset?)",
+        skip, len(files))
 
 
 class RecordReader:

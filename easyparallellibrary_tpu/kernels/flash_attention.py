@@ -27,7 +27,7 @@ Two implementations per kernel, dispatched by sequence length:
   computed (``pl.when``), and blocks default wider (1024) to amortize
   grid-step overhead.
 
-The crossover (``_RESIDENT_MAX_ELEMS``) is conservative: resident wins
+The crossover (``_RESIDENT_MAX_BYTES``) is conservative: resident wins
 measured 1.7x at S=2048 and ~13% at S=8192/D=64; streaming is the only
 option past the VMEM wall.
 
@@ -77,11 +77,12 @@ def _score_tile(qblk, kblk, q_start, k_start, causal: bool, scale: float):
 
 # --------------------------------------------------------------- forward --
 
-# Largest per-array S*D (elements) the resident kernels may hold whole in
-# VMEM: 512K elems = 1 MB bf16 per array; with double-buffering and 2-4
-# resident arrays per kernel this stays well inside the 16 MB budget
-# (S=8192 at D=64 measured fine; S=16384 overflows).
-_RESIDENT_MAX_ELEMS = 512 * 1024
+# Largest per-array S*D footprint (BYTES, so fp32 operands halve the
+# sequence reach) the resident kernels may hold whole in VMEM: 1 MB per
+# array; with double-buffering and 2-4 resident arrays per kernel this
+# stays well inside the 16 MB budget (bf16 S=8192 at D=64 measured fine;
+# S=16384 overflows).
+_RESIDENT_MAX_BYTES = 1024 * 1024
 
 
 def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
@@ -198,8 +199,8 @@ def _bwd_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref,
   dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _resident_ok(S: int, Skv: int, D: int) -> bool:
-  return max(S, Skv) * D <= _RESIDENT_MAX_ELEMS
+def _resident_ok(S: int, Skv: int, D: int, itemsize: int) -> bool:
+  return max(S, Skv) * D * itemsize <= _RESIDENT_MAX_BYTES
 
 
 def _kv_clamp_idx(bq: int, bk: int, causal: bool):
@@ -301,7 +302,7 @@ def _fwd(q, k, v, causal: bool, block_q: int, block_k: int):
   _check_blocks(S, Skv, bq, bk)
   scale = 1.0 / np.sqrt(D)
 
-  if _resident_ok(S, Skv, D):
+  if _resident_ok(S, Skv, D, q.dtype.itemsize):
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel_resident, block_k=bk, causal=causal,
                           scale=scale),
@@ -453,7 +454,7 @@ def _bwd_kernels(q, k, v, dout, lse8, delta8, causal, block_q, block_k):
   _check_blocks(S, Skv, bq, bk)
   scale = 1.0 / np.sqrt(D)
 
-  if _resident_ok(S, Skv, D):
+  if _resident_ok(S, Skv, D, q.dtype.itemsize):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel_resident, block_q=bq,
                           causal=causal, scale=scale),
@@ -636,8 +637,10 @@ def flash_attention_lse(q, k, v, causal: bool = True,
   this wrapper is the layout-friendly public entry point for external
   composition, e.g. KV-chunked decoding."""
   B, S, H, D = q.shape
-  bq = min(block_q, S) if block_q else _default_block(S, d=D)
-  bk = min(block_k, S) if block_k else _default_block(S, d=D)
+  bq = (min(block_q, S) if block_q else
+        _default_block(S, d=D, itemsize=q.dtype.itemsize))
+  bk = (min(block_k, S) if block_k else
+        _default_block(S, d=D, itemsize=q.dtype.itemsize))
   if not bq or not bk or S % bq or S % bk:
     raise ValueError(f"block sizes ({bq}, {bk}) must divide seq len {S}")
   qt = q.transpose(0, 2, 1, 3)
@@ -647,7 +650,8 @@ def flash_attention_lse(q, k, v, causal: bool = True,
   return out.transpose(0, 2, 1, 3), lse.transpose(0, 2, 1)
 
 
-def _default_block(S: int, want: int = 0, d: int = 64) -> int:
+def _default_block(S: int, want: int = 0, *, d: int,
+                   itemsize: int = 2) -> int:
   """Largest block <= `want` that divides S (halving from `want`, floor
   8 to stay sublane-aligned); S itself when shorter than `want`;
   0 when NO such block divides S (e.g. S = 515) — callers must either
@@ -659,7 +663,7 @@ def _default_block(S: int, want: int = 0, d: int = 64) -> int:
   S=4096-8192 over 512 blocks).  `d` must match the head dim the kernel
   will run with so this agrees with `_resident_ok`'s dispatch."""
   if not want:
-    want = 512 if S * d <= _RESIDENT_MAX_ELEMS else 1024
+    want = 512 if S * d * itemsize <= _RESIDENT_MAX_BYTES else 1024
   if S <= want:
     return S
   b = want
@@ -668,11 +672,13 @@ def _default_block(S: int, want: int = 0, d: int = 64) -> int:
   return b if S % b == 0 else 0
 
 
-def flash_blockable(S: int, d: int = 64) -> bool:
+def flash_blockable(S: int, *, d: int, itemsize: int = 2) -> bool:
   """Whether the flash kernels can tile sequence length S with the
   default block search (dispatchers use this to fall back to einsum
-  formulations instead of raising)."""
-  return _default_block(S, d=d) > 0
+  formulations instead of raising).  `d` is required so blockability
+  can never silently disagree with `_resident_ok`'s dispatch for the
+  head dim actually in use."""
+  return _default_block(S, d=d, itemsize=itemsize) > 0
 
 
 def flash_attention(q, k, v, causal: bool = True,
@@ -690,8 +696,10 @@ def flash_attention(q, k, v, causal: bool = True,
   tile 1 MB fp32 + K/V blocks 128 KB).
   """
   B, S, H, D = q.shape
-  bq = min(block_q, S) if block_q else _default_block(S, d=D)
-  bk = min(block_k, S) if block_k else _default_block(S, d=D)
+  bq = (min(block_q, S) if block_q else
+        _default_block(S, d=D, itemsize=q.dtype.itemsize))
+  bk = (min(block_k, S) if block_k else
+        _default_block(S, d=D, itemsize=q.dtype.itemsize))
   if not bq or not bk or S % bq or S % bk:
     raise ValueError(f"block sizes ({bq}, {bk}) must divide seq len {S}")
   # Kernels use [B, H, S, D] layout.

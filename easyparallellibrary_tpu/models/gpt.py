@@ -635,6 +635,135 @@ def make_gpt_1f1b_grad_fn(model: GPT):
   return grad_fn
 
 
+def make_gpt_smap_grad_fn(model: GPT, mesh=None):
+  """Asynchronous shard_map pipeline gradient function for GPT.
+
+  The per-device-program twin of :func:`make_gpt_1f1b_grad_fn`, built on
+  ``parallel.pipeline_smap``: stage boundaries are explicit ppermutes,
+  bubble ticks and masked uneven-stage slots genuinely skip compute
+  (real ``lax.cond`` branches — impossible in the vmapped engines where
+  cond lowers to select), and the tied embedding/LM head are
+  **stage-resident**: the [V, D] table is vocab-sharded over the stage
+  axis ([V/S, D] per stage group — vs fully replicated in the other two
+  engines), with the lookup and softmax-CE computed collectively.
+  Reference analog: boundary layers placed on the first/last stage via
+  arbitrary per-stage taskgraphs (epl/parallel/graph_editor.py:423-443);
+  this distributes their memory AND compute across all stage groups.
+
+  Accepts the same (boxed) parameter tree as the other pipeline paths,
+  so checkpoints move freely between engines.  Returns
+  ``grad_fn(params, batch, rng) -> ((loss, metrics), grads)``.
+
+  Prototype constraints (each raises): tied embeddings only, no MoE, no
+  tensor_parallel, no interleave, ``vocab_size % pipeline_stages == 0``.
+  """
+  from easyparallellibrary_tpu.env import Env
+  from easyparallellibrary_tpu.parallel.pipeline_smap import (
+      make_smap_gpipe_grad_fn, sharded_softmax_ce, vocab_partial_embed)
+  from easyparallellibrary_tpu.parallel.schedule_1f1b import (
+      split_micro_batches)
+  from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
+
+  cfg = resolve_model_dtypes(model.cfg)
+  S, M = cfg.pipeline_stages, cfg.num_micro_batch
+  if S <= 1:
+    raise ValueError("smap pipeline needs pipeline_stages > 1")
+  if cfg.pipeline_interleave > 1:
+    raise ValueError("pipeline_interleave > 1 not supported on the smap "
+                     "engine yet")
+  if cfg.num_experts > 0:
+    raise ValueError("MoE on the smap engine is not supported yet")
+  if not cfg.tie_embeddings:
+    raise ValueError("the smap engine requires tie_embeddings=True (the "
+                     "stage-resident head is the tied table)")
+  if cfg.tensor_parallel:
+    raise ValueError("tensor_parallel composes with the vmapped engines; "
+                     "smap-engine TP is not wired yet")
+  if cfg.vocab_size % S:
+    raise ValueError(f"vocab_size {cfg.vocab_size} must divide into "
+                     f"{S} stage-resident shards")
+  blocks_per_stage, n_active = stage_layout(cfg.num_layers, S,
+                                            cfg.stage_plan)
+  n_active_arr = None if n_active is None else jnp.asarray(n_active)
+  if mesh is None:
+    mesh = Env.get().cluster.mesh
+
+  ln_f = LayerNorm(dtype=cfg.dtype)
+  policy = _remat_policy(cfg.remat_policy)
+
+  def feed_fn(p, mb, rng):
+    ids = mb["inputs"]
+    x = jax.lax.psum(vocab_partial_embed(p["wte"]["embedding"], ids),
+                     constants.STAGE_AXIS)
+    return x.astype(cfg.dtype) + \
+        p["wpe"][None, :ids.shape[1]].astype(cfg.dtype)
+
+  def stage_fn(p, x, rng):
+    s_idx = jax.lax.axis_index(constants.STAGE_AXIS)
+    row = p["pipeline"]["stages"]["stacked"]
+    train = cfg.dropout_rate > 0 and rng is not None
+    for i in range(blocks_per_stage):
+      bp = jax.tree_util.tree_map(lambda l: l[0], row[f"block_{i}"])
+      blk = Block(cfg, use_moe=False, deterministic=not train)
+
+      def apply_blk(xx, bp=bp, blk=blk, i=i):
+        rngs = ({"dropout": jax.random.fold_in(rng, i)}
+                if train else None)
+        return blk.apply({"params": bp}, xx, rngs=rngs)
+
+      if cfg.remat:
+        apply_blk = jax.checkpoint(apply_blk, policy=policy,
+                                   prevent_cse=False)
+      if n_active_arr is None:
+        x = apply_blk(x)
+      else:
+        # Real branch under shard_map: a masked slot costs nothing.
+        x = jax.lax.cond(i < n_active_arr[s_idx], apply_blk,
+                         lambda xx: xx, x)
+    return x
+
+  def emit_fn(p, y, mb, valid, rng):
+    h = ln_f.apply({"params": p["ln_f"]}, y)
+    w = p["wte"]["embedding"]                      # [V/S, D] local slice
+
+    def slab(hh):
+      # Mirrors Embedding.attend (x @ table.T in activation dtype) on
+      # the local vocab shard; rematerialized so the [mb, s, V/S] slab
+      # is never a saved residual.
+      return jnp.matmul(hh, w.T.astype(hh.dtype))
+
+    ll = jax.lax.cond(
+        valid, jax.checkpoint(slab),
+        lambda hh: jnp.zeros(hh.shape[:-1] + (w.shape[0],), hh.dtype), h)
+    loss = sharded_softmax_ce(ll, mb["targets"], z_loss=cfg.z_loss)
+    return jnp.mean(loss)
+
+  engine_cache = {}
+
+  def grad_fn(params, batch, rng):
+    un = nn.meta.unbox(params)
+    if "fn" not in engine_cache:
+      specs = jax.tree_util.tree_map(lambda _: P(), un)
+      specs["wte"]["embedding"] = P(constants.STAGE_AXIS, None)
+      specs["pipeline"]["stages"]["stacked"] = jax.tree_util.tree_map(
+          lambda _: P(constants.STAGE_AXIS),
+          un["pipeline"]["stages"]["stacked"])
+      engine_cache["fn"] = make_smap_gpipe_grad_fn(
+          feed_fn, stage_fn, emit_fn, S, M, mesh, specs)
+    ids = batch["ids"]
+    mbs = split_micro_batches(
+        {"inputs": ids[:, :-1], "targets": ids[:, 1:]}, M)
+    (loss, metrics), g = engine_cache["fn"](un, mbs, rng)
+    grads = jax.tree_util.tree_map(
+        lambda box, gg: box.replace_boxed(gg)
+        if isinstance(box, nn.meta.AxisMetadata) else gg,
+        params, g,
+        is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata))
+    return (loss, metrics), grads
+
+  return grad_fn
+
+
 def auto_parallel_gpt(cfg: GPTConfig, config=None) -> GPT:
   """Auto-parallel model build: plan pipeline stages automatically.
 

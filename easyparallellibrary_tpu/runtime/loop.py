@@ -48,7 +48,8 @@ def fit(step_fn: Callable,
         log_every: int = 50,
         profiler: Optional[StepProfiler] = None,
         shardings=None,
-        checkpoint_on_preemption: bool = True):
+        checkpoint_on_preemption: bool = True,
+        metrics_writer=None):
   """Run `num_steps` of `step_fn(state, batch, rng) -> (state, metrics)`.
 
   `data` yields batches (already global/sharded — see io.DevicePrefetcher).
@@ -150,6 +151,14 @@ def fit(step_fn: Callable,
                              jax.random.fold_in(rng, step_idx))
     if profiler is not None:
       profiler.tick()
+    if metrics_writer is not None:
+      # Metrics arriving here are already merged global values
+      # (parallel/metrics.py) — the writer is a pure sink, matching the
+      # reference's summaries-over-merged-tensors contract
+      # (epl/parallel/hooks.py:593-664).  Writers buffer raw device
+      # values; construct them with flush_every=N so the host sync only
+      # happens every N steps and async dispatch survives.
+      metrics_writer.write(step_idx + 1, metrics)
     if log_every and (step_idx + 1) % log_every == 0:
       loss = metrics.get("loss")
       log.info("step %d: loss %s", step_idx + 1,

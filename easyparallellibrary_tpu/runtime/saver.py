@@ -45,6 +45,29 @@ def _unbox(tree):
   return nn.unbox(tree)
 
 
+def _is_box(x) -> bool:
+  import flax.linen as nn
+  return isinstance(x, nn.meta.AxisMetadata)
+
+
+def _boxed_paths_and_leaves(tree):
+  """Like tree_paths_and_leaves but stops at metadata boxes, so padded
+  params can be recognized (paths are identical either way — boxes sit
+  exactly at leaf positions)."""
+  flat, _ = jax.tree_util.tree_flatten_with_path(tree, is_leaf=_is_box)
+  return [(path_str(path), leaf) for path, leaf in flat]
+
+
+def _logical_shape(leaf) -> Optional[Tuple[int, ...]]:
+  """The attested unpadded shape of a PaddedPartitioned leaf, when it
+  differs from the stored value's shape (ops/layers.py)."""
+  ls = getattr(leaf, "logical_shape", None)
+  if ls is None:
+    return None
+  value = leaf.unbox() if _is_box(leaf) else leaf
+  return tuple(ls) if tuple(ls) != tuple(value.shape) else None
+
+
 def _rebox_like(template, tree):
   """Put restored values back inside the template's metadata boxes, so a
   restored tree is a drop-in replacement for live (boxed) params."""
@@ -77,7 +100,7 @@ def save_checkpoint(directory: str, tree, step: Optional[int] = None,
   if is_leader:
     os.makedirs(directory, exist_ok=True)
 
-  flat = tree_paths_and_leaves(_unbox(tree))
+  flat = _boxed_paths_and_leaves(tree)
   index: Dict[str, Any] = {"step": step, "leaves": {}, "shards": []}
   bucket: List[Tuple[str, Any]] = []
   bucket_bytes = 0
@@ -100,7 +123,15 @@ def save_checkpoint(directory: str, tree, step: Optional[int] = None,
     fname = f"shard_{shard_id:05d}.npz"
     arrays = {}
     for path, leaf in bucket:
-      host = fetch(leaf)
+      logical = _logical_shape(leaf)
+      host = fetch(leaf.unbox() if _is_box(leaf) else leaf)
+      if logical is not None:
+        # Layout portability (reference ShardingLoader role,
+        # epl/runtime/saver.py:46-128): pad regions are attested zeros —
+        # checkpoints always store LOGICAL shapes, so a load under a
+        # different model-axis size or tensor_split setting re-pads to
+        # whatever that layout needs.
+        host = host[tuple(slice(0, l) for l in logical)]
       arrays[path] = host
       index["leaves"][path] = {
           "shard": fname, "shape": list(host.shape),
@@ -112,8 +143,12 @@ def save_checkpoint(directory: str, tree, step: Optional[int] = None,
     bucket, bucket_bytes = [], 0
 
   for path, leaf in flat:
-    nbytes = int(np.prod(getattr(leaf, "shape", ()) or (1,))) * \
-        jnp.dtype(getattr(leaf, "dtype", jnp.float32)).itemsize
+    # Size from the unboxed value: metadata boxes expose no shape/dtype,
+    # and a 4-byte default would put everything in one bucket, defeating
+    # the host-memory bound.
+    value = leaf.unbox() if _is_box(leaf) else leaf
+    nbytes = int(np.prod(getattr(value, "shape", ()) or (1,))) * \
+        jnp.dtype(getattr(value, "dtype", jnp.float32)).itemsize
     if bucket and bucket_bytes + nbytes > limit:
       flush()
     bucket.append((path, leaf))
@@ -145,12 +180,22 @@ def _apply_assign_map(path: str, assign_map: Optional[Dict[str, str]]
 
 
 def _slice_to_shape(value: np.ndarray, shape: Tuple[int, ...],
-                    offsets: Optional[Tuple[int, ...]] = None) -> np.ndarray:
-  """begin/size slicing at load (reference saver.py:91-128)."""
+                    offsets: Optional[Tuple[int, ...]] = None,
+                    pad_attested: bool = False) -> np.ndarray:
+  """begin/size slicing at load (reference saver.py:91-128); with
+  `pad_attested` (target is a PaddedPartitioned param) dims where the
+  stored value is SMALLER are zero-padded up to the target — the
+  re-padding half of layout portability.  Unattested smaller dims stay a
+  hard error: padding may only fabricate regions known to be zero."""
   if tuple(value.shape) == tuple(shape):
     return value
   if len(value.shape) != len(shape):
     raise ValueError(f"rank mismatch restoring {value.shape} -> {shape}")
+  if pad_attested and any(v < s for v, s in zip(value.shape, shape)):
+    pad = [(0, max(0, s - v)) for v, s in zip(value.shape, shape)]
+    value = np.pad(value, pad)
+    if tuple(value.shape) == tuple(shape):
+      return value
   offsets = offsets or (0,) * len(shape)
   slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
   if any(o + s > v for o, s, v in zip(offsets, shape, value.shape)):
@@ -195,15 +240,19 @@ def restore_checkpoint(directory: str,
     out = {p: load_leaf(p) for p in index["leaves"]}
     return out, index.get("step")
 
+  flat_boxed, _ = jax.tree_util.tree_flatten_with_path(
+      target, is_leaf=_is_box)
   target_unboxed = _unbox(target)
   flat, treedef = jax.tree_util.tree_flatten_with_path(target_unboxed)
   new_leaves = []
-  for path, leaf in flat:
+  for (path, leaf), (_, boxed) in zip(flat, flat_boxed):
     pstr = path_str(path)
     ckpt_name = _apply_assign_map(pstr, assign_map)
     value = load_leaf(ckpt_name)
     offs = (slice_offsets or {}).get(pstr)
-    value = _slice_to_shape(value, tuple(np.shape(leaf)), offs)
+    value = _slice_to_shape(
+        value, tuple(np.shape(leaf)), offs,
+        pad_attested=getattr(boxed, "logical_shape", None) is not None)
     value = value.astype(np.asarray(leaf).dtype
                          if not hasattr(leaf, "dtype") else leaf.dtype)
     new_leaves.append(value)

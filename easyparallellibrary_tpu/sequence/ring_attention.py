@@ -29,9 +29,11 @@ current block overlaps the transfer of the next.  Two implementations:
 Causality is enforced block-wise in both: a query block fully attends
 to earlier blocks, triangularly to its own, not at all to later ones —
 fully-masked ring steps still rotate but contribute zeros (uniform SPMD
-work; the ~2x causal inefficiency of the contiguous block layout is a
-known trade — a striped/zigzag layout that load-balances the causal
-mask is a possible future refinement).
+work).  The contiguous layout wastes ~2x on causal masks; setting
+``sequence.ring_layout="zigzag"`` assigns half-chunks ``(i, 2n-1-i)``
+to device i so every device carries an equal mix of early and late
+positions and per-step work is balanced (``_zz_fwd_pass`` below;
+measured delta in benchmarks/ring_layout.py).
 """
 
 from __future__ import annotations
@@ -131,7 +133,8 @@ def _ring_fwd_pass(n, causal, q, k0, v0):
   from easyparallellibrary_tpu.kernels.flash_attention import (
       _default_block, _fwd)
   s = q.shape[2]
-  bq = bk = _default_block(s, d=q.shape[3])
+  bq = bk = _default_block(s, d=q.shape[3],
+                           itemsize=q.dtype.itemsize)
   idx = jax.lax.axis_index(constants.SEQ_AXIS) if n > 1 else 0
   O = jnp.zeros(q.shape, jnp.float32)
   L = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
@@ -179,7 +182,8 @@ def _ring_local_bwd(n, causal, residuals, dO):
       _bwd_kernels, _default_block, _tile8)
   q, k0, v0, O, L = residuals
   s = q.shape[2]
-  bq = bk = _default_block(s, d=q.shape[3])
+  bq = bk = _default_block(s, d=q.shape[3],
+                           itemsize=q.dtype.itemsize)
   idx = jax.lax.axis_index(constants.SEQ_AXIS) if n > 1 else 0
   dO = dO.astype(q.dtype)
   delta = jnp.sum(dO.astype(jnp.float32) * O.astype(jnp.float32), axis=-1)
@@ -281,7 +285,8 @@ def _zz_fwd_pass(n, q, k0, v0):
   from easyparallellibrary_tpu.kernels.flash_attention import (
       _default_block, _fwd)
   half = q.shape[2] // 2
-  bq = bk = _default_block(half, d=q.shape[3])
+  bq = bk = _default_block(half, d=q.shape[3],
+                           itemsize=q.dtype.itemsize)
   idx = jax.lax.axis_index(constants.SEQ_AXIS)
   qa, qb = _halves(q)
 
@@ -346,7 +351,8 @@ def _ring_local_zz_bwd(n, residuals, dO):
       _bwd_kernels, _default_block, _tile8)
   q, k0, v0, O, L = residuals
   half = q.shape[2] // 2
-  bq = bk = _default_block(half, d=q.shape[3])
+  bq = bk = _default_block(half, d=q.shape[3],
+                           itemsize=q.dtype.itemsize)
   idx = jax.lax.axis_index(constants.SEQ_AXIS)
   dO = dO.astype(q.dtype)
   delta = jnp.sum(dO.astype(jnp.float32) * O.astype(jnp.float32), axis=-1)
@@ -429,7 +435,8 @@ def _ring_flash(q, k, v, causal: bool):
       flash_blockable)
   zigzag = (env.config.sequence.ring_layout == "zigzag" and causal
             and n > 1 and (S // n) % 2 == 0
-            and flash_blockable(S // n // 2, d=D))
+            and flash_blockable(S // n // 2, d=D,
+                                itemsize=q.dtype.itemsize))
 
   def local(q_l, k_l, v_l):
     qt = q_l.transpose(0, 2, 1, 3)
@@ -475,7 +482,7 @@ def ring_attention(q, k, v, causal: bool = True,
                        f"{axis} ring devices")
     from easyparallellibrary_tpu.kernels.flash_attention import (
         flash_blockable)
-    if flash_blockable(S // axis, d=D):
+    if flash_blockable(S // axis, d=D, itemsize=q.dtype.itemsize):
       return _ring_flash(q, k, v, causal)
     # Per-device block length the kernels can't tile (no power-of-two
     # divisor <= 512): fall through to the einsum formulation rather

@@ -89,7 +89,7 @@ def ulysses_attention(q, k, v, causal: bool = True):
   if n > 1 and Env.get().config.sequence.ulysses_impl == "flash":
     from easyparallellibrary_tpu.kernels.flash_attention import (
         flash_blockable)
-    if flash_blockable(S, d=D):
+    if flash_blockable(S, d=D, itemsize=q.dtype.itemsize):
       return _ulysses_flash(q, k, v, causal)
     # Length the kernels can't tile: the einsum formulation below has
     # no blocking constraint — fall through instead of raising (the

@@ -36,6 +36,24 @@ def constrain(x, spec: P):
   if len(spec) > getattr(x, "ndim", len(spec)):
     raise ValueError(
         f"sharding spec {spec} has more entries than value rank {x.ndim}")
+  # Inside shard_map bodies mesh axes are Manual: a constraint naming one
+  # is an error at lowering time (too late for the except below).  Strip
+  # manual axes from the spec — per-shard values are already placed on
+  # them — and keep any non-manual remainder (partial-manual shard_map).
+  manual = frozenset(
+      getattr(jax.sharding.get_abstract_mesh(), "manual_axes", ()) or ())
+  if manual:
+    def clean(entry):
+      if entry is None or entry is P.UNCONSTRAINED:
+        return entry
+      if isinstance(entry, (tuple, list)):
+        kept = tuple(a for a in entry if a not in manual)
+        return kept if kept else None
+      return None if entry in manual else entry
+
+    spec = P(*(clean(e) for e in spec))
+    if all(e is None or e is P.UNCONSTRAINED for e in spec):
+      return x
   sharding = NamedSharding(cluster.mesh, spec)
   try:
     return jax.lax.with_sharding_constraint(x, sharding)

@@ -207,10 +207,14 @@ def test_communicator_pool_serialization_in_lowered_hlo():
     return jax.jit(f).lower(tree).as_text()
 
   free = lowered_text(0)
+  serial = lowered_text(1)
   pooled = lowered_text(2)
   barrier = "stablehlo.optimization_barrier"
   op = 'stablehlo.all_reduce"'
-  assert free.count(op) == 6 and pooled.count(op) == 6
+  assert free.count(op) == serial.count(op) == pooled.count(op) == 6
   assert free.count(barrier) == 0
-  # 6 one-leaf buckets, pool of 2: buckets 2..5 each wait on i-2.
+  # Pool of 1 fully serializes: buckets 1..5 each wait on i-1; pool of
+  # 2 leaves two in flight: buckets 2..5 wait on i-2.  The knob changes
+  # the lowered schedule monotonically, not just the python plan.
+  assert serial.count(barrier) == 5
   assert pooled.count(barrier) == 4

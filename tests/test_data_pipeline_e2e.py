@@ -104,3 +104,20 @@ def test_reader_skip_records_matches_slice(tmp_path):
       got = list(RecordReader(files, use_native=use_native,
                               skip_records=skip))
       assert got == full[skip:], (use_native, skip)
+
+
+def test_reader_skip_detects_truncation(tmp_path):
+  """A payload cut short mid-record must raise the same IOError from the
+  skip (seek) path as from the read path — a resume offset past a
+  truncated file must not be swallowed as clean EOF (ADVICE r2)."""
+  import pytest
+  from easyparallellibrary_tpu.io.dataloader import _python_reader
+
+  path = str(tmp_path / "trunc.rec")
+  write_records(path, [b"x" * 32, b"y" * 32], use_native=False)
+  with open(path, "r+b") as f:
+    f.truncate(8 + 32 + 8 + 16)  # second payload half gone
+  with pytest.raises(IOError, match="truncated record"):
+    list(_python_reader([path], skip_records=0))
+  with pytest.raises(IOError, match="truncated record"):
+    list(_python_reader([path], skip_records=2))

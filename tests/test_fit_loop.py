@@ -209,3 +209,42 @@ def test_fit_plain_factory_still_works():
 
   state, metrics = fit(step, state, factory, num_steps=3, log_every=0)
   assert int(state.step) == 3
+
+
+def test_tensorboard_writer_renders_in_stock_tensorboard(tmp_path):
+  """VERDICT r2 item 8 done-criterion: the events file written by
+  TensorBoardWriter loads in stock TensorBoard's own reader."""
+  from easyparallellibrary_tpu.utils.metrics_writer import TensorBoardWriter
+
+  logdir = str(tmp_path / "tb")
+  with TensorBoardWriter(logdir) as w:
+    w.write(1, {"loss": jnp.float32(2.5), "mfu": 0.41, "note": "cfg-a"})
+    w.write(2, {"loss": 1.25, "mfu": 0.43})
+
+  from tensorboard.backend.event_processing.event_accumulator import (
+      EventAccumulator)
+  acc = EventAccumulator(logdir)
+  acc.Reload()
+  assert "loss" in acc.Tags()["scalars"]
+  scalars = acc.Scalars("loss")
+  assert [s.step for s in scalars] == [1, 2]
+  assert scalars[0].value == 2.5 and scalars[1].value == 1.25
+  import pytest
+  assert [s.value for s in acc.Scalars("mfu")] == pytest.approx(
+      [0.41, 0.43])
+
+
+def test_fit_feeds_metrics_writer(tmp_path):
+  """fit(metrics_writer=...) streams every step's merged metrics."""
+  import json
+  from easyparallellibrary_tpu.runtime.loop import fit
+  from easyparallellibrary_tpu.utils.metrics_writer import MetricsWriter
+
+  state, shardings, step, batch = _setup()
+  path = str(tmp_path / "m.jsonl")
+  with MetricsWriter(path) as w:
+    state, _ = fit(step, state, [batch], num_steps=3, log_every=0,
+                   metrics_writer=w)
+  lines = [json.loads(l) for l in open(path)]
+  assert [l["step"] for l in lines] == [1, 2, 3]
+  assert all("loss" in l or "mse" in l for l in lines)

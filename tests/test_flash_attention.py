@@ -66,7 +66,7 @@ def test_flash_streaming_path_matches_full(causal, monkeypatch):
   import importlib
   fa_mod = importlib.import_module(
       "easyparallellibrary_tpu.kernels.flash_attention")
-  monkeypatch.setattr(fa_mod, "_RESIDENT_MAX_ELEMS", 1)
+  monkeypatch.setattr(fa_mod, "_RESIDENT_MAX_BYTES", 1)
   q, k, v = _qkv(S=256, seed=4)
   out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
   ref = _full_attention(q, k, v, causal=causal)
@@ -78,7 +78,7 @@ def test_flash_streaming_grads_match(causal, monkeypatch):
   import importlib
   fa_mod = importlib.import_module(
       "easyparallellibrary_tpu.kernels.flash_attention")
-  monkeypatch.setattr(fa_mod, "_RESIDENT_MAX_ELEMS", 1)
+  monkeypatch.setattr(fa_mod, "_RESIDENT_MAX_BYTES", 1)
   q, k, v = _qkv(S=128, seed=5)
 
   def loss_flash(q, k, v):
@@ -100,7 +100,7 @@ def test_flash_streaming_uneven_blocks(monkeypatch):
   import importlib
   fa_mod = importlib.import_module(
       "easyparallellibrary_tpu.kernels.flash_attention")
-  monkeypatch.setattr(fa_mod, "_RESIDENT_MAX_ELEMS", 1)
+  monkeypatch.setattr(fa_mod, "_RESIDENT_MAX_BYTES", 1)
   q, k, v = _qkv(S=256, seed=6)
 
   def loss(attn):

@@ -310,8 +310,8 @@ def test_unblockable_lengths_fall_back_to_einsum():
   back to their einsum formulations, which have no blocking constraint."""
   from easyparallellibrary_tpu.kernels.flash_attention import (
       flash_blockable)
-  assert not flash_blockable(515) and not flash_blockable(1030)
-  assert flash_blockable(512) and flash_blockable(96)
+  assert not flash_blockable(515, d=8) and not flash_blockable(1030, d=8)
+  assert flash_blockable(512, d=8) and flash_blockable(96, d=8)
 
   epl.init(epl.Config({"sequence.parallelism": "ring",
                        "sequence.axis_size": 2,

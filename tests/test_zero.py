@@ -141,3 +141,41 @@ def test_explicit_zero1_reduces_per_device_state_bytes():
   ).compile().memory_analysis()
   assert zmem.argument_size_in_bytes < bmem.argument_size_in_bytes, (
       zmem.argument_size_in_bytes, bmem.argument_size_in_bytes)
+
+
+def test_explicit_zero1_rejects_coupled_optimizer():
+  """Leaf-coupling transforms (global-norm clip) would be computed over
+  1/dp shards; the step must refuse them with guidance instead of
+  silently mis-clipping (reference constraint checks:
+  epl/runtime/zero.py:60-75)."""
+  import optax
+  import pytest
+  from easyparallellibrary_tpu.runtime.zero import make_zero1_train_step
+
+  model, mesh, state, shardings, x = _build("v1")
+  state = state.replace(
+      tx=optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-2)))
+  state = state.replace(opt_state=state.tx.init(
+      jax.tree_util.tree_map(lambda l: l, state.params)))
+  zstep = make_zero1_train_step(_loss_fn(model), mesh)
+  with pytest.raises(ValueError, match="elementwise"):
+    zstep(state, {"x": x, "y": jnp.ones((16, 8))}, jax.random.PRNGKey(0))
+
+
+def test_explicit_zero1_probe_handles_structure_and_slices():
+  """The guard probes with the REAL param structure (so optax.masked
+  passes) and detects within-leaf coupling (clip_by_block_rms raises)."""
+  import optax
+  import pytest
+  from easyparallellibrary_tpu.runtime.zero import _assert_elementwise_tx
+
+  params = {"dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))}}
+  masked = optax.masked(optax.adam(1e-2),
+                        {"dense": {"kernel": True, "bias": False}})
+  _assert_elementwise_tx(masked, params)  # must not raise
+
+  rms = optax.chain(optax.clip_by_block_rms(1.0), optax.adam(1e-2))
+  with pytest.raises(ValueError, match="elementwise"):
+    _assert_elementwise_tx(rms, params)
+
+  _assert_elementwise_tx(optax.adamw(1e-3), params)  # plain case still ok
