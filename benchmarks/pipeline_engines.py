@@ -74,9 +74,11 @@ def main():
   grad_1f1b = make_gpt_1f1b_grad_fn(model)
   f1b = stats(lambda p: grad_1f1b(p, {"ids": ids}, None))
 
-  # shard_map per-device engine.
+  # shard_map per-device engines (GPipe-order autodiff and manual 1F1B).
   grad_smap = make_gpt_smap_grad_fn(model, mesh)
   smap = stats(lambda p: grad_smap(p, {"ids": ids}, None))
+  grad_smap_1f1b = make_gpt_smap_grad_fn(model, mesh, schedule="1f1b")
+  smap_1f1b = stats(lambda p: grad_smap_1f1b(p, {"ids": ids}, None))
 
   # Remat variants: per-stage rematerialization is the memory story the
   # engines are usually run with (pipeline.strategy defaults remat on the
@@ -91,6 +93,7 @@ def main():
       "config": {"stages": S, "micro_batches": M, "layers": L,
                  "vocab": 512, "d_model": 64, "batch": 2 * M, "seq": 32},
       "gpipe_vmap": gpipe, "one_f_one_b_vmap": f1b, "smap": smap,
+      "smap_1f1b": smap_1f1b,
       "gpipe_vmap_remat": gpipe_rm, "smap_remat": smap_rm,
       "smap_vs_gpipe_flops": round(smap["gflops"] / gpipe["gflops"], 3)
       if gpipe["gflops"] else None,

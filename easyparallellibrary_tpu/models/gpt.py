@@ -635,7 +635,7 @@ def make_gpt_1f1b_grad_fn(model: GPT):
   return grad_fn
 
 
-def make_gpt_smap_grad_fn(model: GPT, mesh=None):
+def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "gpipe"):
   """Asynchronous shard_map pipeline gradient function for GPT.
 
   The per-device-program twin of :func:`make_gpt_1f1b_grad_fn`, built on
@@ -651,7 +651,9 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None):
   this distributes their memory AND compute across all stage groups.
 
   Accepts the same (boxed) parameter tree as the other pipeline paths,
-  so checkpoints move freely between engines.  Returns
+  so checkpoints move freely between engines.  ``schedule``: "gpipe"
+  (autodiff order) or "1f1b" (manual wavefront, residual-ring memory
+  bound, dead ramp sub-ticks skipped).  Returns
   ``grad_fn(params, batch, rng) -> ((loss, metrics), grads)``.
 
   Prototype constraints (each raises): tied embeddings only, no MoE, no
@@ -659,7 +661,8 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None):
   """
   from easyparallellibrary_tpu.env import Env
   from easyparallellibrary_tpu.parallel.pipeline_smap import (
-      make_smap_gpipe_grad_fn, sharded_softmax_ce, vocab_partial_embed)
+      make_smap_1f1b_grad_fn, make_smap_gpipe_grad_fn, sharded_softmax_ce,
+      vocab_partial_embed)
   from easyparallellibrary_tpu.parallel.schedule_1f1b import (
       split_micro_batches)
   from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
@@ -682,6 +685,8 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None):
   if cfg.vocab_size % S:
     raise ValueError(f"vocab_size {cfg.vocab_size} must divide into "
                      f"{S} stage-resident shards")
+  if schedule not in ("gpipe", "1f1b"):
+    raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
   blocks_per_stage, n_active = stage_layout(cfg.num_layers, S,
                                             cfg.stage_plan)
   n_active_arr = None if n_active is None else jnp.asarray(n_active)
@@ -740,7 +745,7 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None):
 
   engine_cache = {}
 
-  def grad_fn(params, batch, rng):
+  def grad_fn(params, batch, rng, loss_scale=None):
     un = nn.meta.unbox(params)
     if "fn" not in engine_cache:
       specs = jax.tree_util.tree_map(lambda _: P(), un)
@@ -748,12 +753,20 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None):
       specs["pipeline"]["stages"]["stacked"] = jax.tree_util.tree_map(
           lambda _: P(constants.STAGE_AXIS),
           un["pipeline"]["stages"]["stacked"])
-      engine_cache["fn"] = make_smap_gpipe_grad_fn(
+      build = (make_smap_1f1b_grad_fn if schedule == "1f1b"
+               else make_smap_gpipe_grad_fn)
+      engine_cache["fn"] = build(
           feed_fn, stage_fn, emit_fn, S, M, mesh, specs)
     ids = batch["ids"]
     mbs = split_micro_batches(
         {"inputs": ids[:, :-1], "targets": ids[:, 1:]}, M)
-    (loss, metrics), g = engine_cache["fn"](un, mbs, rng)
+    if schedule == "1f1b":
+      (loss, metrics), g = engine_cache["fn"](un, mbs, rng, loss_scale)
+    else:
+      if loss_scale is not None:
+        raise ValueError("loss_scale seeding needs schedule='1f1b' "
+                         "(the gpipe path is plain autodiff)")
+      (loss, metrics), g = engine_cache["fn"](un, mbs, rng)
     grads = jax.tree_util.tree_map(
         lambda box, gg: box.replace_boxed(gg)
         if isinstance(box, nn.meta.AxisMetadata) else gg,
