@@ -1,0 +1,41 @@
+#!/bin/bash
+# Hardware measurement suite — run when the relay is healthy.
+# Fills the BASELINE.md matrix: every row gets a real-chip number and
+# bench.py persists raw chain timings into BENCH_EVIDENCE.json.
+cd /root/repo || exit 1
+mkdir -p HW
+export EPL_BENCH_PROBE_BUDGET_S=600
+
+echo "=== hw_suite start $(date -u +%FT%TZ) ==="
+
+echo "--- bench.py (GPT-350M headline, raw timings -> BENCH_EVIDENCE) ---"
+timeout 3600 python bench.py | tee HW/bench_gpt350m.json
+
+echo "--- single_chip_models: resnet50 (row 1) ---"
+timeout 1800 python benchmarks/single_chip_models.py resnet50 \
+  | tee HW/row1_resnet50.json
+
+echo "--- single_chip_models: bert_large (row 2) ---"
+timeout 1800 python benchmarks/single_chip_models.py bert_large \
+  | tee HW/row2_bert_large.json
+
+echo "--- single_chip_models: tp_head (row 3 model) ---"
+timeout 1800 python benchmarks/single_chip_models.py tp_head \
+  | tee HW/row3_tp_head.json
+
+echo "--- single_chip_models: gpt_moe (row 5 model + a2a share) ---"
+timeout 1800 python benchmarks/single_chip_models.py gpt_moe \
+  | tee HW/row5_gpt_moe.json
+
+echo "--- flash autotune sweep (if present) ---"
+if [ -f benchmarks/flash_autotune.py ]; then
+  timeout 2400 python benchmarks/flash_autotune.py | tee HW/flash_autotune.json
+fi
+
+echo "--- zigzag ring compiled-mode check (if present) ---"
+if [ -f benchmarks/ring_layout.py ]; then
+  timeout 1800 python benchmarks/ring_layout.py --compiled 2>/dev/null \
+    | tee HW/ring_zigzag.json
+fi
+
+echo "=== hw_suite done $(date -u +%FT%TZ) ==="

@@ -31,14 +31,24 @@ PEAK_FLOPS = {
 }
 
 
-def peak_flops_per_chip(device: Optional[jax.Device] = None) -> float:
+def peak_flops_info(device: Optional[jax.Device] = None
+                    ) -> "tuple[float, bool]":
+  """(peak bf16 FLOP/s, recognized?) for the device kind.  The single
+  source of truth for every MFU denominator in the repo (bench.py imports
+  this — the tables must not fork and drift)."""
   device = device or jax.devices()[0]
   kind = device.device_kind
   for name, flops in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
     if kind.startswith(name):
-      return flops
-  get_logger().warning("unknown device kind %r; assuming 197 TFLOP/s", kind)
-  return 197e12
+      return flops, True
+  get_logger().warning("unknown device kind %r; assuming 197 TFLOP/s — "
+                       "MFU numbers against this denominator are guesses",
+                       kind)
+  return 197e12, False
+
+
+def peak_flops_per_chip(device: Optional[jax.Device] = None) -> float:
+  return peak_flops_info(device)[0]
 
 
 def compiled_cost(fn: Callable, *args, **kwargs) -> Dict[str, float]:

@@ -181,17 +181,26 @@ def _apply_assign_map(path: str, assign_map: Optional[Dict[str, str]]
 
 def _slice_to_shape(value: np.ndarray, shape: Tuple[int, ...],
                     offsets: Optional[Tuple[int, ...]] = None,
-                    pad_attested: bool = False) -> np.ndarray:
+                    logical_shape: Optional[Tuple[int, ...]] = None
+                    ) -> np.ndarray:
   """begin/size slicing at load (reference saver.py:91-128); with
-  `pad_attested` (target is a PaddedPartitioned param) dims where the
-  stored value is SMALLER are zero-padded up to the target — the
-  re-padding half of layout portability.  Unattested smaller dims stay a
-  hard error: padding may only fabricate regions known to be zero."""
+  `logical_shape` (target is a PaddedPartitioned param attesting that
+  shape) a stored value matching the logical shape exactly is zero-padded
+  up to the target — the re-padding half of layout portability.  Padding
+  may only fabricate regions known to be zero, so a stored value that
+  does NOT cover the whole logical region is a hard error, never silently
+  zero-filled."""
   if tuple(value.shape) == tuple(shape):
     return value
   if len(value.shape) != len(shape):
     raise ValueError(f"rank mismatch restoring {value.shape} -> {shape}")
-  if pad_attested and any(v < s for v, s in zip(value.shape, shape)):
+  if logical_shape is not None and any(
+      v < s for v, s in zip(value.shape, shape)):
+    if tuple(value.shape) != tuple(logical_shape):
+      raise ValueError(
+          f"stored shape {tuple(value.shape)} does not match the target's "
+          f"attested logical shape {tuple(logical_shape)}; refusing to "
+          f"zero-pad into the logical region (padded target {tuple(shape)})")
     pad = [(0, max(0, s - v)) for v, s in zip(value.shape, shape)]
     value = np.pad(value, pad)
     if tuple(value.shape) == tuple(shape):
@@ -252,7 +261,7 @@ def restore_checkpoint(directory: str,
     offs = (slice_offsets or {}).get(pstr)
     value = _slice_to_shape(
         value, tuple(np.shape(leaf)), offs,
-        pad_attested=getattr(boxed, "logical_shape", None) is not None)
+        logical_shape=_logical_shape(boxed))
     value = value.astype(np.asarray(leaf).dtype
                          if not hasattr(leaf, "dtype") else leaf.dtype)
     new_leaves.append(value)

@@ -118,21 +118,31 @@ def _assert_elementwise_tx(tx, params) -> None:
   constraints structurally (epl/runtime/zero.py:60-75); optax transforms
   are opaque closures, so the check is behavioral: on a probe tree with
   the REAL param structure (so structure-keyed transforms like
-  ``optax.masked`` probe correctly) but tiny [4, 4] leaves, perturb one
-  element of the first leaf and require every other position's update to
-  be unchanged.  A probe that cannot run (exotic shape-dependent
-  transform) logs a warning instead of blocking — the guard is advisory,
-  coupling it can SEE is a hard error.
+  ``optax.masked`` probe correctly) but uniform [128, 128] leaves,
+  perturb one element of the first and last leaves and require every
+  other position's update to be unchanged.  The probe size matters:
+  optax's factored RMS statistics (adafactor /
+  ``scale_by_factored_rms``) only factor leaves whose dims reach
+  ``min_dim_size_to_factor`` (128), so a smaller probe would pass
+  adafactor as elementwise while real-size leaves couple positions.
+  128x128 fp32 leaves keep the probe cheap while tripping every
+  size-gated transform at its default threshold.  A probe that cannot
+  run (exotic shape-dependent transform) logs a warning instead of
+  blocking — the guard is advisory, coupling it can SEE is a hard error.
   """
-  shape = (4, 4)
+  shape = (128, 128)
   probe_p = jax.tree_util.tree_map(
       lambda _: jnp.ones(shape, jnp.float32), params)
   g_base = jax.tree_util.tree_map(
       lambda _: jnp.full(shape, 0.5, jnp.float32), probe_p)
   leaves, treedef = jax.tree_util.tree_flatten(g_base)
   # Large perturbation so norm/rms-dependent rescaling is unmistakable.
-  g_pert = jax.tree_util.tree_unflatten(
-      treedef, [leaves[0].at[0, 0].set(1e3)] + leaves[1:])
+  # Perturb first AND last leaves so structure-keyed transforms
+  # (optax.masked) that only touch later leaves are still exercised.
+  pert_idx = sorted({0, len(leaves) - 1})
+  pert_leaves = [l.at[0, 0].set(1e3) if i in pert_idx else l
+                 for i, l in enumerate(leaves)]
+  g_pert = jax.tree_util.tree_unflatten(treedef, pert_leaves)
   try:
     state = tx.init(probe_p)
     u_base, s_base = tx.update(g_base, state, probe_p)
@@ -147,16 +157,16 @@ def _assert_elementwise_tx(tx, params) -> None:
   mask0 = np.ones(shape, bool)
   mask0[0, 0] = False
 
-  def differs(a, b, first):
+  def differs(a, b, masked):
     a, b = np.asarray(a), np.asarray(b)
-    if first and a.shape == shape:
+    if masked and a.shape == shape:
       a, b = a[mask0], b[mask0]
     return not np.allclose(a, b, rtol=1e-5, atol=1e-7)
 
   ub = jax.tree_util.tree_leaves(u_base)
   up = jax.tree_util.tree_leaves(u_pert)
-  coupled = differs(ub[0], up[0], True) or any(
-      differs(a, b, False) for a, b in zip(ub[1:], up[1:]))
+  coupled = any(differs(a, b, i in pert_idx)
+                for i, (a, b) in enumerate(zip(ub, up)))
   # Scale-invariant optimizers (adam) normalize a uniform clip rescale
   # OUT of the first-step update, but the new optimizer STATE still sees
   # the rescaled gradients everywhere — check it too.  State leaves that
@@ -171,7 +181,8 @@ def _assert_elementwise_tx(tx, params) -> None:
     raise ValueError(
         "explicit ZeRO-1 requires an elementwise optimizer: this optax "
         "transform couples positions (e.g. optax.clip_by_global_norm "
-        "across leaves, clip_by_block_rms within a leaf), so applying it "
+        "across leaves, clip_by_block_rms or factored adafactor "
+        "statistics within a leaf), so applying it "
         "to per-owner 1/dp shards would compute the coupling over local "
         "slices only.  Either drop the coupled transform, or use GSPMD "
         "optimizer-state sharding (zero.level='v0') where the update "

@@ -218,3 +218,19 @@ def test_unattested_shape_mismatch_still_raises(tmp_path):
   target = {"w": jnp.zeros((4, 8))}
   with pytest.raises(ValueError, match="out of bounds"):
     restore_checkpoint(path, target=target)
+
+
+def test_attested_repad_requires_logical_coverage():
+  """ADVICE r3: re-padding a PaddedPartitioned target may only fabricate
+  the attested pad region — a stored value that does not cover the whole
+  logical region must raise, never silently zero-fill real parameters."""
+  from easyparallellibrary_tpu.runtime.saver import _slice_to_shape
+
+  # Stored == logical: pads up to the padded target, pad region zero.
+  out = _slice_to_shape(np.ones((8, 10)), (16, 10), logical_shape=(8, 10))
+  assert out.shape == (16, 10)
+  assert (out[8:] == 0).all() and (out[:8] == 1).all()
+
+  # Stored smaller than logical: rows 4..8 are REAL parameters — refuse.
+  with pytest.raises(ValueError, match="logical"):
+    _slice_to_shape(np.ones((4, 10)), (16, 10), logical_shape=(8, 10))

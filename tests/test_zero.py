@@ -179,3 +179,21 @@ def test_explicit_zero1_probe_handles_structure_and_slices():
     _assert_elementwise_tx(rms, params)
 
   _assert_elementwise_tx(optax.adamw(1e-3), params)  # plain case still ok
+
+
+def test_explicit_zero1_probe_catches_factored_adafactor():
+  """ADVICE r3: optax's factored RMS statistics only factor leaves whose
+  dims reach min_dim_size_to_factor (128), so a tiny probe would pass
+  adafactor as elementwise while real-size leaves couple positions.  The
+  128x128 probe must reject it."""
+  import optax
+  import pytest
+  from easyparallellibrary_tpu.runtime.zero import _assert_elementwise_tx
+
+  params = {"dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))}}
+  ada = optax.adafactor(learning_rate=1e-3, clipping_threshold=None)
+  with pytest.raises(ValueError, match="elementwise"):
+    _assert_elementwise_tx(ada, params)
+  # Default adafactor (with update clipping, also coupled) too.
+  with pytest.raises(ValueError, match="elementwise"):
+    _assert_elementwise_tx(optax.adafactor(learning_rate=1e-3), params)
