@@ -6,36 +6,42 @@ cd /root/repo || exit 1
 mkdir -p HW
 export EPL_BENCH_PROBE_BUDGET_S=600
 
+# run <timeout_s> <json_out> <cmd...>: full stdout goes to <json_out>.raw,
+# the LAST line (the JSON report; progress lines go first or to stderr)
+# to <json_out>, so consumers can json.load every artifact.
+run() {
+  local t="$1" out="$2"; shift 2
+  timeout "$t" "$@" > "$out.raw" 2>> HW/suite.err
+  local rc=$?
+  tail -n 1 "$out.raw" > "$out"
+  echo "[$(date -u +%FT%TZ)] $* -> rc=$rc $(cat "$out")"
+}
+
 echo "=== hw_suite start $(date -u +%FT%TZ) ==="
 
 echo "--- bench.py (GPT-350M headline, raw timings -> BENCH_EVIDENCE) ---"
-timeout 3600 python bench.py | tee HW/bench_gpt350m.json
+run 3600 HW/bench_gpt350m.json python bench.py
 
 echo "--- single_chip_models: resnet50 (row 1) ---"
-timeout 1800 python benchmarks/single_chip_models.py resnet50 \
-  | tee HW/row1_resnet50.json
+run 1800 HW/row1_resnet50.json python benchmarks/single_chip_models.py resnet50
 
 echo "--- single_chip_models: bert_large (row 2) ---"
-timeout 1800 python benchmarks/single_chip_models.py bert_large \
-  | tee HW/row2_bert_large.json
+run 1800 HW/row2_bert_large.json python benchmarks/single_chip_models.py bert_large
 
 echo "--- single_chip_models: tp_head (row 3 model) ---"
-timeout 1800 python benchmarks/single_chip_models.py tp_head \
-  | tee HW/row3_tp_head.json
+run 1800 HW/row3_tp_head.json python benchmarks/single_chip_models.py tp_head
 
-echo "--- single_chip_models: gpt_moe (row 5 model + a2a share) ---"
-timeout 1800 python benchmarks/single_chip_models.py gpt_moe \
-  | tee HW/row5_gpt_moe.json
+echo "--- single_chip_models: gpt_moe (row 5 model) ---"
+run 1800 HW/row5_gpt_moe.json python benchmarks/single_chip_models.py gpt_moe
 
 echo "--- flash autotune sweep (if present) ---"
 if [ -f benchmarks/flash_autotune.py ]; then
-  timeout 2400 python benchmarks/flash_autotune.py | tee HW/flash_autotune.json
+  run 2400 HW/flash_autotune.json python benchmarks/flash_autotune.py
 fi
 
-echo "--- zigzag ring compiled-mode check (if present) ---"
-if [ -f benchmarks/ring_layout.py ]; then
-  timeout 1800 python benchmarks/ring_layout.py --compiled 2>/dev/null \
-    | tee HW/ring_zigzag.json
+echo "--- smap boundary-collective overhead (if present) ---"
+if [ -f benchmarks/smap_overhead.py ]; then
+  run 1800 HW/smap_overhead.json python benchmarks/smap_overhead.py
 fi
 
 echo "=== hw_suite done $(date -u +%FT%TZ) ==="

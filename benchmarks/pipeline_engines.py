@@ -75,7 +75,9 @@ def main():
   f1b = stats(lambda p: grad_1f1b(p, {"ids": ids}, None))
 
   # shard_map per-device engines (GPipe-order autodiff and manual 1F1B).
-  grad_smap = make_gpt_smap_grad_fn(model, mesh)
+  # Schedules are pinned explicitly: the builder's DEFAULT is "1f1b", so
+  # relying on it here would silently relabel the rows.
+  grad_smap = make_gpt_smap_grad_fn(model, mesh, schedule="gpipe")
   smap = stats(lambda p: grad_smap(p, {"ids": ids}, None))
   grad_smap_1f1b = make_gpt_smap_grad_fn(model, mesh, schedule="1f1b")
   smap_1f1b = stats(lambda p: grad_smap_1f1b(p, {"ids": ids}, None))
@@ -86,7 +88,8 @@ def main():
   rm = GPT(GPTConfig(**dict(base, remat=True)))
   gpipe_rm = stats(jax.value_and_grad(
       lambda p: gpt_loss(rm, p, {"ids": ids})[0]))
-  smap_rm = stats(lambda p, g=make_gpt_smap_grad_fn(rm, mesh):
+  smap_rm = stats(lambda p, g=make_gpt_smap_grad_fn(rm, mesh,
+                                                    schedule="gpipe"):
                   g(p, {"ids": ids}, None))
 
   print(json.dumps({

@@ -24,7 +24,8 @@ EOF
   then
     echo "relay alive at $(date -u +%FT%TZ) (attempt $i)" >> HW/watch.log
     bash benchmarks/hw_suite.sh >> HW/suite.log 2>&1
-    echo "suite finished at $(date -u +%FT%TZ) rc=$?" >> HW/watch.log
+    rc=$?
+    echo "suite finished at $(date -u +%FT%TZ) rc=$rc" >> HW/watch.log
     exit 0
   fi
   echo "probe $i dead at $(date -u +%FT%TZ)" >> HW/watch.log

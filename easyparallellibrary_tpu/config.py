@@ -180,6 +180,12 @@ class PipelineConfig(_Category):
       "strategy": constants.SCHEDULE_PREFER_BACKWARD,
       # Interleaved (circular) pipeline: blocks per stage > 1.
       "num_stages_per_device": 1,
+      # Pipeline engine: "" (= "vmap", the lockstep SPMD engines) or
+      # "smap" (per-device stage programs under shard_map — real-branch
+      # bubbles, stage-resident boundary layers; see
+      # parallel/pipeline_smap.py).  The schedule policy above still
+      # picks GPipe vs 1F1B order within either engine.
+      "engine": "",
   }
 
 
@@ -380,6 +386,9 @@ class Config:
       raise ValueError("pipeline.num_micro_batch must be >= 1")
     if self.pipeline.num_stages < 1:
       raise ValueError("pipeline.num_stages must be >= 1")
+    if self.pipeline.engine not in ("", "vmap", "smap"):
+      raise ValueError("pipeline.engine must be '', 'vmap' or 'smap'; "
+                       f"got {self.pipeline.engine!r}")
     if self.communication.gradients_reduce_method not in ("mean", "sum"):
       raise ValueError("communication.gradients_reduce_method must be "
                        "'mean' or 'sum'")

@@ -321,13 +321,52 @@ def _remat_policy(name: str):
   return None
 
 
+def _engine_is_smap(cfg: GPTConfig) -> bool:
+  """True when the active Config dispatches the shard_map pipeline engine
+  for this (pipelined) model.  Safe before epl.init (returns False)."""
+  if cfg.pipeline_stages <= 1:
+    return False
+  try:
+    from easyparallellibrary_tpu.env import Env
+    return Env.get().config.pipeline.engine == "smap"
+  except Exception:
+    return False
+
+
 def _tied_embedding(cfg: GPTConfig, name=None) -> Embedding:
   """Token-embedding construction shared by the forward pass, the chunked
   tied-head CE, and the 1F1B emit head — one site so the tied table's
-  sharding/init can never silently diverge between them."""
-  return Embedding(cfg.vocab_size, cfg.d_model,
-                   parallel="vocab" if cfg.tensor_parallel else "none",
+  sharding/init can never silently diverge between them.
+
+  Under the smap pipeline engine (without TP) the table is boxed
+  stage-vocab-sharded, so `create_sharded_train_state` commits it at
+  [V/S, D] per stage group — the stage-resident boundary layout the
+  engine's in-specs expect, now also the table's *resident* layout
+  (params + adam moments shrink S-fold)."""
+  if cfg.tensor_parallel:
+    parallel = "vocab"
+  elif _engine_is_smap(cfg):
+    parallel = "stage_vocab"
+  else:
+    parallel = "none"
+  return Embedding(cfg.vocab_size, cfg.d_model, parallel=parallel,
                    param_dtype=cfg.param_dtype, name=name)
+
+
+def _lm_head(cfg: GPTConfig, name=None) -> "Dense":
+  """Untied LM head, shared by the forward pass and the pipeline emit
+  heads.  Mirrors :func:`_tied_embedding`'s engine awareness: under the
+  smap engine (without TP) the kernel is committed stage-vocab-sharded
+  ([D, V/S] per stage group) so the head is genuinely stage-resident,
+  not just resharded per call."""
+  if cfg.tensor_parallel:
+    parallel = "column"
+  elif _engine_is_smap(cfg):
+    parallel = "stage_column"
+  else:
+    parallel = "none"
+  return Dense(cfg.vocab_size, parallel=parallel, use_bias=False,
+               dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
 
 
 class GPT(nn.Module):
@@ -421,10 +460,7 @@ class GPT(nn.Module):
     if cfg.tie_embeddings:
       logits = tok.attend(x)
     else:
-      logits = Dense(cfg.vocab_size,
-                     parallel="column" if cfg.tensor_parallel else "none",
-                     use_bias=False, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype, name="lm_head")(x)
+      logits = _lm_head(cfg, name="lm_head")(x)
     return logits
 
 
@@ -554,10 +590,7 @@ def make_gpt_1f1b_grad_fn(model: GPT):
   ln_f = LayerNorm(dtype=cfg.dtype)
   head = None
   if not cfg.tie_embeddings:
-    head = Dense(cfg.vocab_size,
-                 parallel="column" if cfg.tensor_parallel else "none",
-                 use_bias=False, dtype=cfg.dtype,
-                 param_dtype=cfg.param_dtype)
+    head = _lm_head(cfg)
 
   def build(train: bool):
     stage_mod = StageBlocks(cfg, blocks_per_stage=blocks_per_stage,
@@ -635,7 +668,7 @@ def make_gpt_1f1b_grad_fn(model: GPT):
   return grad_fn
 
 
-def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "gpipe"):
+def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
   """Asynchronous shard_map pipeline gradient function for GPT.
 
   The per-device-program twin of :func:`make_gpt_1f1b_grad_fn`, built on
@@ -651,13 +684,23 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "gpipe"):
   this distributes their memory AND compute across all stage groups.
 
   Accepts the same (boxed) parameter tree as the other pipeline paths,
-  so checkpoints move freely between engines.  ``schedule``: "gpipe"
-  (autodiff order) or "1f1b" (manual wavefront, residual-ring memory
-  bound, dead ramp sub-ticks skipped).  Returns
+  so checkpoints move freely between engines.  ``schedule``: "1f1b"
+  (default — manual wavefront, residual-ring memory bound, dead ramp
+  sub-ticks skipped; also the engine's best memory point, see
+  BASELINE.md round-3 table) or "gpipe" (autodiff order; worst temp
+  bytes of the four engines at the benchmark shape).  Returns
   ``grad_fn(params, batch, rng) -> ((loss, metrics), grads)``.
 
-  Prototype constraints (each raises): tied embeddings only, no MoE, no
-  tensor_parallel, no interleave, ``vocab_size % pipeline_stages == 0``.
+  Tensor parallelism composes: the shard_map is manual over
+  ``stage``/``data`` only, so TP weights keep their model-axis GSPMD
+  shardings inside the stage program and XLA inserts the row-parallel
+  psums as in the non-pipelined path (requires an unpadded vocab:
+  ``vocab_size`` divisible by the model axis).  Untied embeddings
+  compose: the LM head kernel is stage-vocab-sharded ([D, V/S] per
+  stage) just like the tied table.
+
+  Remaining constraints (each raises): no MoE, no interleave,
+  ``vocab_size % pipeline_stages == 0``.
   """
   from easyparallellibrary_tpu.env import Env
   from easyparallellibrary_tpu.parallel.pipeline_smap import (
@@ -676,12 +719,6 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "gpipe"):
                      "engine yet")
   if cfg.num_experts > 0:
     raise ValueError("MoE on the smap engine is not supported yet")
-  if not cfg.tie_embeddings:
-    raise ValueError("the smap engine requires tie_embeddings=True (the "
-                     "stage-resident head is the tied table)")
-  if cfg.tensor_parallel:
-    raise ValueError("tensor_parallel composes with the vmapped engines; "
-                     "smap-engine TP is not wired yet")
   if cfg.vocab_size % S:
     raise ValueError(f"vocab_size {cfg.vocab_size} must divide into "
                      f"{S} stage-resident shards")
@@ -692,6 +729,15 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "gpipe"):
   n_active_arr = None if n_active is None else jnp.asarray(n_active)
   if mesh is None:
     mesh = Env.get().cluster.mesh
+  if cfg.tensor_parallel:
+    model_size = dict(zip(mesh.axis_names,
+                          mesh.devices.shape)).get(constants.MODEL_AXIS, 1)
+    if cfg.vocab_size % max(model_size, 1):
+      raise ValueError(
+          f"smap engine with tensor_parallel needs an unpadded vocab "
+          f"table: vocab_size {cfg.vocab_size} must divide the model "
+          f"axis ({model_size}) — padded vocab rows would corrupt the "
+          f"stage-resident CE normalizer")
 
   ln_f = LayerNorm(dtype=cfg.dtype)
   policy = _remat_policy(cfg.remat_policy)
@@ -729,17 +775,25 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "gpipe"):
 
   def emit_fn(p, y, mb, valid, rng):
     h = ln_f.apply({"params": p["ln_f"]}, y)
-    w = p["wte"]["embedding"]                      # [V/S, D] local slice
+    if cfg.tie_embeddings:
+      w = p["wte"]["embedding"]                    # [V/S, D] local slice
+      Vs = w.shape[0]
 
-    def slab(hh):
-      # Mirrors Embedding.attend (x @ table.T in activation dtype) on
-      # the local vocab shard; rematerialized so the [mb, s, V/S] slab
-      # is never a saved residual.
-      return jnp.matmul(hh, w.T.astype(hh.dtype))
+      def slab(hh):
+        # Mirrors Embedding.attend (x @ table.T in activation dtype) on
+        # the local vocab shard; rematerialized so the [mb, s, V/S] slab
+        # is never a saved residual.
+        return jnp.matmul(hh, w.T.astype(hh.dtype))
+    else:
+      w = p["lm_head"]["kernel"]                   # [D, V/S] local slice
+      Vs = w.shape[1]
+
+      def slab(hh):
+        return jnp.matmul(hh, w.astype(hh.dtype))
 
     ll = jax.lax.cond(
         valid, jax.checkpoint(slab),
-        lambda hh: jnp.zeros(hh.shape[:-1] + (w.shape[0],), hh.dtype), h)
+        lambda hh: jnp.zeros(hh.shape[:-1] + (Vs,), hh.dtype), h)
     loss = sharded_softmax_ce(ll, mb["targets"], z_loss=cfg.z_loss)
     return jnp.mean(loss)
 
@@ -748,15 +802,22 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "gpipe"):
   def grad_fn(params, batch, rng, loss_scale=None):
     un = nn.meta.unbox(params)
     if "fn" not in engine_cache:
+      # Manual (stage/data) projection only: model-axis TP shardings ride
+      # the argument arrays through the auto axes (partial-manual
+      # shard_map — see pipeline_smap module docstring).
       specs = jax.tree_util.tree_map(lambda _: P(), un)
       specs["wte"]["embedding"] = P(constants.STAGE_AXIS, None)
+      if not cfg.tie_embeddings:
+        specs["lm_head"]["kernel"] = P(None, constants.STAGE_AXIS)
       specs["pipeline"]["stages"]["stacked"] = jax.tree_util.tree_map(
           lambda _: P(constants.STAGE_AXIS),
           un["pipeline"]["stages"]["stacked"])
       build = (make_smap_1f1b_grad_fn if schedule == "1f1b"
                else make_smap_gpipe_grad_fn)
       engine_cache["fn"] = build(
-          feed_fn, stage_fn, emit_fn, S, M, mesh, specs)
+          feed_fn, stage_fn, emit_fn, S, M, mesh, specs,
+          manual_axes=frozenset({constants.STAGE_AXIS,
+                                 constants.DATA_AXIS}))
     ids = batch["ids"]
     mbs = split_micro_batches(
         {"inputs": ids[:, :-1], "targets": ids[:, 1:]}, M)
@@ -832,13 +893,23 @@ def auto_parallel_gpt(cfg: GPTConfig, config=None) -> GPT:
 
 
 def make_gpt_train_step(model: GPT, config=None):
-  """Config-driven train step for GPT, schedule-aware.
+  """Config-driven train step for GPT, engine- and schedule-aware.
 
-  Under ``PreferBackward``/``PreferBackwardOptimizer`` with pipeline
-  stages, gradients come from the true 1F1B engine
-  (reference: epl/strategies/scheduler.py:53-116 orders backward-k before
-  forward-k+1 — here the interleave is explicit in one scan); otherwise
-  the standard autodiff path (`build_train_step` over :func:`gpt_loss`).
+  ``pipeline.engine`` selects the pipeline engine (reference analog: the
+  scheduler registry dispatch, epl/strategies/scheduler.py:120-131):
+
+    * ""/"vmap" — the lockstep SPMD engines; ``PreferBackward``/
+      ``PreferBackwardOptimizer`` pick the true-1F1B wavefront
+      (reference scheduler.py:53-116 orders backward-k before
+      forward-k+1 — here the interleave is explicit in one scan),
+      ``PreferForward`` the GPipe autodiff path.
+    * "smap" — the per-device shard_map engine
+      (:func:`make_gpt_smap_grad_fn`); the schedule policy still picks
+      the order within it (PreferBackward* → "1f1b", PreferForward →
+      "gpipe").
+
+  Non-pipelined configs use the standard autodiff path
+  (`build_train_step` over :func:`gpt_loss`) regardless of engine.
   """
   from easyparallellibrary_tpu.env import Env
   from easyparallellibrary_tpu.runtime.trainer import build_train_step
@@ -850,6 +921,14 @@ def make_gpt_train_step(model: GPT, config=None):
   use_1f1b = False
   if cfg.pipeline_stages > 1 and not cfg.pipeline_debug_sequential:
     sched = get_scheduler(cfg.pipeline_schedule or conf.pipeline.strategy)
+    if conf.pipeline.engine == "smap":
+      groups = None
+      if sched.grouped_apply and conf.optimizer.num_apply_group <= 1:
+        groups = cfg.pipeline_stages
+      schedule = "1f1b" if sched.remat_stage else "gpipe"
+      return build_train_step(
+          grad_fn=make_gpt_smap_grad_fn(model, schedule=schedule),
+          config=conf, num_apply_group=groups)
     use_1f1b = sched.remat_stage  # PreferBackward / PreferBackwardOptimizer
     if use_1f1b and cfg.pipeline_interleave > 1:
       from easyparallellibrary_tpu.utils.logging import get_logger

@@ -147,9 +147,9 @@ class Dense(nn.Module):
         m = re.fullmatch(r"Dense_(\d+)", self.name or "")
         if m and int(m.group(1)) % 2 == 1:
           mode = "row"
-    if mode not in ("none", "column", "row"):
-      raise ValueError(f"Dense.parallel must be auto/none/column/row, "
-                       f"got {self.parallel!r}")
+    if mode not in ("none", "column", "row", "stage_column"):
+      raise ValueError(f"Dense.parallel must be auto/none/column/row/"
+                       f"stage_column, got {self.parallel!r}")
     in_features = x.shape[-1]
     model = _model_axis_size()
     out_features = self.features
@@ -176,6 +176,14 @@ class Dense(nn.Module):
           self.kernel_init, (constants.MODEL_AXIS, None),
           (in_features, out_features))
       bias_spec = (None,)
+    elif mode == "stage_column":
+      # Stage-resident head for the smap pipeline engine: the feature
+      # (vocab) dim is committed over the stage axis ([in, V/S] per
+      # stage group), the compute is the plain matmul — stage collectives
+      # are the engine's job, not this layer's.
+      kernel_init = nn.with_partitioning(
+          self.kernel_init, (None, constants.STAGE_AXIS))
+      bias_spec = (constants.STAGE_AXIS,)
     else:
       # Box even unsharded params (all-None spec): lifted transforms like
       # the pipeline's nn.vmap extend metadata with the stage axis, which
@@ -242,6 +250,14 @@ class Embedding(nn.Module):
           self.embedding_init, (constants.MODEL_AXIS, None),
           (self.num_embeddings, self.features))
       shape = (padded, self.features)
+    elif self.parallel == "stage_vocab":
+      # Stage-resident table for the smap pipeline engine: committed at
+      # [V/S, D] per stage group (vocab must divide the stage axis — the
+      # engine validates).  Lookups outside the engine (eval/generate)
+      # still work: GSPMD gathers across the stage axis.
+      init = nn.with_partitioning(self.embedding_init,
+                                  (constants.STAGE_AXIS, None))
+      shape = (self.num_embeddings, self.features)
     else:
       init = nn.with_partitioning(self.embedding_init, (None, None))
       shape = (self.num_embeddings, self.features)

@@ -44,6 +44,15 @@ compute sits inside ``cond`` branches.  Stage-axis peers may take
 different branches, but ``model``/``data``-axis peers always share a
 stage index and therefore a predicate, so collectives over those axes
 inside a stage function remain safe.
+
+Tensor parallelism composes via *partial-manual* shard_map
+(``manual_axes``): the engine is manual over ``stage`` (and ``data``)
+only, leaving the ``model`` axis to GSPMD — inside the per-device stage
+program, TP weights keep their model-axis shardings and XLA inserts the
+row-parallel psums automatically, exactly as in the non-pipelined path.
+This is the TPU answer to the reference nesting ``split`` inside a
+pipeline stage scope (epl/strategies/strategy_context.py:34-54): the
+stage program is manual, the tensor math inside it stays GSPMD.
 """
 
 from __future__ import annotations
@@ -151,6 +160,7 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
                             param_specs,
                             *,
                             batch_spec: Optional[P] = None,
+                            manual_axes: Optional[frozenset] = None,
                             check_specs=None) -> Callable:
   """Build the shard_map pipeline gradient function.
 
@@ -176,6 +186,13 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
   Returns ``grad_fn(params, mbs, rng) -> ((loss, metrics), grads)`` over
   GLOBAL arrays: params laid out per `param_specs`, `mbs` micro-batched
   [M, batch, ...] and data-sharded, grads matching `param_specs`.
+
+  ``manual_axes``: mesh axes the engine is manual over (default: all —
+  the original full-manual formulation).  Pass
+  ``frozenset({"stage", "data"})`` to leave the ``model`` axis to GSPMD
+  so tensor-parallel weights/collectives inside `stage_fn` keep working
+  untouched (see module docstring); `param_specs` must then mention
+  manual axes only — auto-axis shardings ride the argument arrays.
   """
   S, M = num_stages, num_micro_batch
   if S < 2:
@@ -258,6 +275,7 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
       local_grad, mesh=mesh,
       in_specs=(param_specs, bspec, P()),
       out_specs=((P(), {}), param_specs),
+      axis_names=manual_axes if manual_axes is not None else frozenset(),
       check_vma=False)
 
   def grad_fn(params, mbs, rng):
@@ -274,7 +292,9 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
                            mesh: Mesh,
                            param_specs,
                            *,
-                           batch_spec: Optional[P] = None) -> Callable:
+                           batch_spec: Optional[P] = None,
+                           manual_axes: Optional[frozenset] = None
+                           ) -> Callable:
   """True-1F1B shard_map pipeline gradient function.
 
   Same local-function contracts as :func:`make_smap_gpipe_grad_fn`, but
@@ -435,6 +455,7 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
       local_grad, mesh=mesh,
       in_specs=(param_specs, bspec, P(), P()),
       out_specs=((P(), {}), param_specs),
+      axis_names=manual_axes if manual_axes is not None else frozenset(),
       check_vma=False)
 
   def grad_fn(params, mbs, rng, loss_scale=None):

