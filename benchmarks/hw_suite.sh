@@ -39,6 +39,9 @@ if [ -f benchmarks/flash_autotune.py ]; then
   run 2400 HW/flash_autotune.json python benchmarks/flash_autotune.py
 fi
 
+echo "--- zigzag ring compiled-mode check ---"
+run 1800 HW/ring_zigzag.json python benchmarks/ring_layout.py
+
 echo "--- smap boundary-collective overhead (if present) ---"
 if [ -f benchmarks/smap_overhead.py ]; then
   run 1800 HW/smap_overhead.json python benchmarks/smap_overhead.py

@@ -56,8 +56,8 @@ def main():
                     jnp.int32)
   params = model.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
 
-  def stats(fn):
-    compiled = jax.jit(fn).lower(params).compile()
+  def stats(fn, p=None):
+    compiled = jax.jit(fn).lower(p if p is not None else params).compile()
     cost = compiled.cost_analysis() or {}
     mem = compiled.memory_analysis()
     return {
@@ -92,11 +92,38 @@ def main():
                                                     schedule="gpipe"):
                   g(p, {"ids": ids}, None))
 
+  # Megatron-interleaved 1F1B on the smap engine (K=2 virtual chunks per
+  # device): same layer count, so compiled FLOPs should track smap-1f1b
+  # while the schedule's ramp shrinks from 2(S-1) K-chunk ticks to
+  # 2(S-1) + (K-1)S one-chunk ticks.
+  from easyparallellibrary_tpu.parallel.pipeline_interleaved import (
+      build_interleaved_schedule)
+  K_iv = 2
+  iv = GPT(GPTConfig(**dict(base, pipeline_interleave=K_iv)))
+  params_iv = iv.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+  grad_iv = make_gpt_smap_grad_fn(iv, mesh)
+  smap_iv = stats(lambda p: grad_iv(p, {"ids": ids}, None), params_iv)
+  sch = build_interleaved_schedule(S, K_iv, M)
+  smap_iv.update({
+      "ticks": sch.T,
+      "ramp_ticks_1chunk": sch.T - M * K_iv,
+      "busy_slot_frac": round(sch.busy_slots / sch.total_slots, 3),
+  })
+  # Plain 1F1B tick accounting at the same shape, for the bubble table:
+  # M + 2(S-1) ticks, each K_iv chunks of work wide.
+  plain_bubble = {
+      "ticks": M + 2 * (S - 1),
+      "ramp_ticks_Kchunk": 2 * (S - 1),
+      "ramp_chunkwork": 2 * (S - 1) * K_iv,
+      "interleaved_ramp_chunkwork": sch.T - M * K_iv,
+  }
+
   print(json.dumps({
       "config": {"stages": S, "micro_batches": M, "layers": L,
                  "vocab": 512, "d_model": 64, "batch": 2 * M, "seq": 32},
       "gpipe_vmap": gpipe, "one_f_one_b_vmap": f1b, "smap": smap,
-      "smap_1f1b": smap_1f1b,
+      "smap_1f1b": smap_1f1b, "smap_interleaved_k2": smap_iv,
+      "bubble_accounting_k2": plain_bubble,
       "gpipe_vmap_remat": gpipe_rm, "smap_remat": smap_rm,
       "smap_vs_gpipe_flops": round(smap["gflops"] / gpipe["gflops"], 3)
       if gpipe["gflops"] else None,

@@ -699,8 +699,15 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
   compose: the LM head kernel is stage-vocab-sharded ([D, V/S] per
   stage) just like the tied table.
 
-  Remaining constraints (each raises): no MoE, no interleave,
-  ``vocab_size % pipeline_stages == 0``.
+  Megatron-interleaved 1F1B (``pipeline_interleave`` K > 1): the K
+  chained pipeline passes become K virtual chunks per device and the
+  table-driven schedule of ``parallel.pipeline_interleaved`` shrinks the
+  ramp from 2(S-1) ticks of K-chunk work to 2(S-1) + (K-1)S ticks of
+  one-chunk work (schedule="1f1b" upgrades automatically when K > 1).
+
+  Remaining constraints (each raises): no MoE,
+  ``vocab_size % pipeline_stages == 0``, interleave needs the 1F1B-order
+  schedule.
   """
   from easyparallellibrary_tpu.env import Env
   from easyparallellibrary_tpu.parallel.pipeline_smap import (
@@ -712,19 +719,28 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
 
   cfg = resolve_model_dtypes(model.cfg)
   S, M = cfg.pipeline_stages, cfg.num_micro_batch
+  K = max(1, cfg.pipeline_interleave)
   if S <= 1:
     raise ValueError("smap pipeline needs pipeline_stages > 1")
-  if cfg.pipeline_interleave > 1:
-    raise ValueError("pipeline_interleave > 1 not supported on the smap "
-                     "engine yet")
+  if schedule == "1f1b" and K > 1:
+    schedule = "interleaved"
+  if schedule == "interleaved" and K < 2:
+    raise ValueError("schedule='interleaved' needs pipeline_interleave "
+                     ">= 2 (K virtual chunks per device)")
+  if schedule == "gpipe" and K > 1:
+    raise ValueError(
+        "pipeline_interleave > 1 on the smap engine requires the "
+        "interleaved-1F1B schedule (pipeline.strategy PreferBackward*); "
+        "GPipe order does not interleave chunks")
   if cfg.num_experts > 0:
     raise ValueError("MoE on the smap engine is not supported yet")
   if cfg.vocab_size % S:
     raise ValueError(f"vocab_size {cfg.vocab_size} must divide into "
                      f"{S} stage-resident shards")
-  if schedule not in ("gpipe", "1f1b"):
-    raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
-  blocks_per_stage, n_active = stage_layout(cfg.num_layers, S,
+  if schedule not in ("gpipe", "1f1b", "interleaved"):
+    raise ValueError(f"schedule must be gpipe|1f1b|interleaved, "
+                     f"got {schedule!r}")
+  blocks_per_stage, n_active = stage_layout(cfg.num_layers, S * K,
                                             cfg.stage_plan)
   n_active_arr = None if n_active is None else jnp.asarray(n_active)
   if mesh is None:
@@ -749,12 +765,24 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
     return x.astype(cfg.dtype) + \
         p["wpe"][None, :ids.shape[1]].astype(cfg.dtype)
 
-  def stage_fn(p, x, rng):
+  def stage_fn(p, x, rng, chunk=None):
+    """One stage's blocks.  `chunk` (interleaved only) is the LOCAL
+    chunk index; the params tree then carries the K passes stacked on
+    axis 1 of each stacked leaf ([1, K, ...] per device) and the block
+    row is dynamically selected — the dynamic index transposes to the
+    right gradient rows automatically."""
     s_idx = jax.lax.axis_index(constants.STAGE_AXIS)
     row = p["pipeline"]["stages"]["stacked"]
     train = cfg.dropout_rate > 0 and rng is not None
+    if chunk is None:
+      sel = lambda l: l[0]
+      v_idx = s_idx            # layer-order chunk id == stage id
+    else:
+      sel = lambda l: jax.lax.dynamic_index_in_dim(l[0], chunk, 0,
+                                                   keepdims=False)
+      v_idx = chunk * S + s_idx  # virtual stage = layer-order chunk id
     for i in range(blocks_per_stage):
-      bp = jax.tree_util.tree_map(lambda l: l[0], row[f"block_{i}"])
+      bp = jax.tree_util.tree_map(sel, row[f"block_{i}"])
       blk = Block(cfg, use_moe=False, deterministic=not train)
 
       def apply_blk(xx, bp=bp, blk=blk, i=i):
@@ -769,7 +797,7 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
         x = apply_blk(x)
       else:
         # Real branch under shard_map: a masked slot costs nothing.
-        x = jax.lax.cond(i < n_active_arr[s_idx], apply_blk,
+        x = jax.lax.cond(i < n_active_arr[v_idx], apply_blk,
                          lambda xx: xx, x)
     return x
 
@@ -799,8 +827,34 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
 
   engine_cache = {}
 
+  def to_engine_tree(un):
+    """K=1: identity.  K>1: stack the K pipeline passes on axis 1 of
+    each stacked leaf ([S, K, ...] globally — dim 0 stays the stage
+    split), under the same 'pipeline' path the K=1 tree uses.  Pass k
+    row d is virtual stage k*S + d, so the contiguous stage split
+    already realizes Megatron's circular placement — no permutation."""
+    if K == 1:
+      return un
+    passes = [un[f"pipeline_{k}"]["stages"]["stacked"] for k in range(K)]
+    combined = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls, axis=1), *passes)
+    eng = {key: v for key, v in un.items()
+           if not key.startswith("pipeline_")}
+    eng["pipeline"] = {"stages": {"stacked": combined}}
+    return eng
+
+  def from_engine_grads(g):
+    if K == 1:
+      return g
+    comb = g["pipeline"]["stages"]["stacked"]
+    out = {key: v for key, v in g.items() if key != "pipeline"}
+    for k in range(K):
+      out[f"pipeline_{k}"] = {"stages": {"stacked": jax.tree_util.tree_map(
+          lambda l, k=k: l[:, k], comb)}}
+    return out
+
   def grad_fn(params, batch, rng, loss_scale=None):
-    un = nn.meta.unbox(params)
+    un = to_engine_tree(nn.meta.unbox(params))
     if "fn" not in engine_cache:
       # Manual (stage/data) projection only: model-axis TP shardings ride
       # the argument arrays through the auto axes (partial-manual
@@ -812,22 +866,30 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
       specs["pipeline"]["stages"]["stacked"] = jax.tree_util.tree_map(
           lambda _: P(constants.STAGE_AXIS),
           un["pipeline"]["stages"]["stacked"])
-      build = (make_smap_1f1b_grad_fn if schedule == "1f1b"
-               else make_smap_gpipe_grad_fn)
-      engine_cache["fn"] = build(
-          feed_fn, stage_fn, emit_fn, S, M, mesh, specs,
-          manual_axes=frozenset({constants.STAGE_AXIS,
-                                 constants.DATA_AXIS}))
+      manual = frozenset({constants.STAGE_AXIS, constants.DATA_AXIS})
+      if schedule == "interleaved":
+        from easyparallellibrary_tpu.parallel.pipeline_interleaved import (
+            make_smap_interleaved_grad_fn)
+        engine_cache["fn"] = make_smap_interleaved_grad_fn(
+            feed_fn, stage_fn, emit_fn, S, K, M, mesh, specs,
+            manual_axes=manual)
+      else:
+        build = (make_smap_1f1b_grad_fn if schedule == "1f1b"
+                 else make_smap_gpipe_grad_fn)
+        engine_cache["fn"] = build(
+            feed_fn, stage_fn, emit_fn, S, M, mesh, specs,
+            manual_axes=manual)
     ids = batch["ids"]
     mbs = split_micro_batches(
         {"inputs": ids[:, :-1], "targets": ids[:, 1:]}, M)
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "interleaved"):
       (loss, metrics), g = engine_cache["fn"](un, mbs, rng, loss_scale)
     else:
       if loss_scale is not None:
         raise ValueError("loss_scale seeding needs schedule='1f1b' "
                          "(the gpipe path is plain autodiff)")
       (loss, metrics), g = engine_cache["fn"](un, mbs, rng)
+    g = from_engine_grads(g)
     grads = jax.tree_util.tree_map(
         lambda box, gg: box.replace_boxed(gg)
         if isinstance(box, nn.meta.AxisMetadata) else gg,
@@ -919,12 +981,15 @@ def make_gpt_train_step(model: GPT, config=None):
   conf = config if config is not None else Env.get().config
   sched = None
   use_1f1b = False
+  groups = None
   if cfg.pipeline_stages > 1 and not cfg.pipeline_debug_sequential:
     sched = get_scheduler(cfg.pipeline_schedule or conf.pipeline.strategy)
+    # PreferBackwardOptimizer's grouped apply (reference interleaves the
+    # optimizer with the backward, scheduler.py:86-116): default to one
+    # group per stage when the config doesn't pin a count.
+    if sched.grouped_apply and conf.optimizer.num_apply_group <= 1:
+      groups = cfg.pipeline_stages
     if conf.pipeline.engine == "smap":
-      groups = None
-      if sched.grouped_apply and conf.optimizer.num_apply_group <= 1:
-        groups = cfg.pipeline_stages
       schedule = "1f1b" if sched.remat_stage else "gpipe"
       return build_train_step(
           grad_fn=make_gpt_smap_grad_fn(model, schedule=schedule),
@@ -934,8 +999,9 @@ def make_gpt_train_step(model: GPT, config=None):
       from easyparallellibrary_tpu.utils.logging import get_logger
       get_logger().warning(
           "pipeline.strategy=%s requests 1F1B but pipeline_interleave=%d "
-          "is not supported by the interleaved engine yet; falling back "
-          "to the GPipe autodiff path (M live activations per stage).",
+          "is only interleaved on the shard_map engine "
+          "(pipeline.engine='smap'); falling back to the GPipe autodiff "
+          "path (M live activations per stage).",
           sched.name, cfg.pipeline_interleave)
       use_1f1b = False
 
@@ -943,12 +1009,6 @@ def make_gpt_train_step(model: GPT, config=None):
     return build_train_step(lambda p, b, r: gpt_loss(model, p, b, r),
                             config=conf)
 
-  # PreferBackwardOptimizer's grouped apply (reference interleaves the
-  # optimizer with the backward, scheduler.py:86-116): default to one
-  # group per stage when the config doesn't pin a count.
-  groups = None
-  if sched.grouped_apply and conf.optimizer.num_apply_group <= 1:
-    groups = cfg.pipeline_stages
   # build_train_step owns AMP loss scaling (the engine seeds its backward
   # with the scale), overflow skipping, and grouped apply.
   return build_train_step(grad_fn=make_gpt_1f1b_grad_fn(model),
