@@ -61,6 +61,10 @@ class GPTConfig:
   capacity_factor: float = 1.25
   moe_aux_weight: float = 0.01
   moe_top_k: int = 1
+  # "einsum" (GSPMD chooses collectives) | "a2a" (explicit all_to_all
+  # dispatch/combine over the expert axis — the reference's M6-style EP
+  # dataflow; see models/moe.py).
+  moe_impl: str = "einsum"
   # Sequence parallelism: constrain activations over the seq axis.
   seq_parallel: bool = False
   attn_impl: str = "xla"             # xla | pallas_flash | ring
@@ -233,7 +237,8 @@ class Block(nn.Module):
     y = LayerNorm(dtype=cfg.dtype, name="ln2")(x)
     if self.use_moe:
       from easyparallellibrary_tpu.models.moe import MoEMLP
-      x = x + drop(MoEMLP(cfg, top_k=cfg.moe_top_k, name="moe")(y))
+      x = x + drop(MoEMLP(cfg, top_k=cfg.moe_top_k, impl=cfg.moe_impl,
+                          name="moe")(y))
     else:
       x = x + drop(MLP(cfg, name="mlp")(y))
     return _constrain(x, _act_spec(cfg))

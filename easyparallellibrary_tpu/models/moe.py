@@ -36,14 +36,70 @@ from easyparallellibrary_tpu import constants
 from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
 
 
+def _top_k_dispatch(probs, top_k: int, E: int, capacity: int, dtype):
+  """Shared top-k routing -> (dispatch [T,E,C], combine [T,E,C], assign).
+
+  `assign` is the PRE-capacity router choice mask (for the aux loss:
+  with post-drop counts, the worse the overflow, the weaker the penalty
+  would look)."""
+  dispatch_list, combine_list, assign_list = [], [], []
+  remaining = probs
+  fill = jnp.zeros((E,), jnp.int32)
+  for _ in range(top_k):
+    gate = jnp.max(remaining, axis=-1)                   # [T]
+    idx = jnp.argmax(remaining, axis=-1)                 # [T]
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)     # [T, E]
+    assign_list.append(onehot)
+    # Position of each token within its expert queue (0-based), offset
+    # by tokens already placed in earlier choices.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot + fill[None, :]
+    keep = (pos < capacity) * onehot                     # [T, E]
+    pos_in_cap = jnp.sum(pos * keep, axis=-1)            # [T]
+    dispatch = keep[..., None] * jax.nn.one_hot(
+        pos_in_cap, capacity, dtype=jnp.int32)[:, None, :]  # [T, E, C]
+    dispatch_list.append(dispatch)
+    combine_list.append(dispatch.astype(jnp.float32) *
+                        gate[:, None, None])
+    fill = fill + jnp.sum(keep, axis=0)
+    remaining = remaining * (1 - jax.nn.one_hot(idx, E))
+  return (sum(dispatch_list).astype(dtype),
+          sum(combine_list).astype(dtype),
+          sum(assign_list))
+
+
 class MoEMLP(nn.Module):
-  """Drop-in replacement for the dense MLP block (same in/out shape)."""
+  """Drop-in replacement for the dense MLP block (same in/out shape).
+
+  ``impl``:
+    * "einsum" (default) — dispatch/combine as einsums against the
+      [T, E, C] mask with expert-sharded tensors; GSPMD chooses the
+      collectives (on token-replicated expert groups it picks
+      local-compute + reductions, no all-to-all needed).
+    * "a2a" — EXPLICIT expert-parallel dispatch: tokens sharded over the
+      expert axis, routed locally, exchanged with two
+      ``jax.lax.all_to_all`` rounds (dispatch + combine) inside a
+      partial-manual shard_map.  This is the reference's M6-style EP
+      dataflow (NCCL AllToAll around the expert einsums,
+      epl/parallel/hooks.py:758-794 + csrc/communicators/
+      nccl_all_to_all.cc) — use it when tokens live distributed across
+      the expert group; capacity is enforced per SOURCE device
+      (ceil(cf * T_local / E) each), so drops can differ from the
+      einsum path's global bound under cross-device routing imbalance.
+  """
 
   cfg: Any                       # GPTConfig
   top_k: int = 1
+  impl: str = "einsum"
 
   @nn.compact
   def __call__(self, x):
+    if self.impl not in ("einsum", "a2a"):
+      raise ValueError(f"MoEMLP.impl must be einsum|a2a: {self.impl!r}")
+    if self.impl == "a2a":
+      return self._a2a_path(x)
+    return self._einsum_path(x)
+
+  def _einsum_path(self, x):
     cfg = self.cfg
     B, S, D = x.shape
     E = cfg.num_experts
@@ -65,31 +121,8 @@ class MoEMLP(nn.Module):
     probs = jax.nn.softmax(router_logits, axis=-1)
 
     # --- Top-k dispatch mask with capacity -------------------------------
-    dispatch_list = []
-    combine_list = []
-    assign_list = []      # pre-capacity router choices (for the aux loss)
-    remaining = probs
-    # Running per-expert fill across the k choices.
-    fill = jnp.zeros((E,), jnp.int32)
-    for _ in range(self.top_k):
-      gate = jnp.max(remaining, axis=-1)                   # [T]
-      idx = jnp.argmax(remaining, axis=-1)                 # [T]
-      onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)     # [T, E]
-      assign_list.append(onehot)
-      # Position of each token within its expert queue (0-based), offset
-      # by tokens already placed in earlier choices.
-      pos = jnp.cumsum(onehot, axis=0) * onehot - onehot + fill[None, :]
-      keep = (pos < capacity) * onehot                     # [T, E]
-      pos_in_cap = jnp.sum(pos * keep, axis=-1)            # [T]
-      dispatch = keep[..., None] * jax.nn.one_hot(
-          pos_in_cap, capacity, dtype=jnp.int32)[:, None, :]  # [T, E, C]
-      dispatch_list.append(dispatch)
-      combine_list.append(dispatch.astype(jnp.float32) *
-                          gate[:, None, None])
-      fill = fill + jnp.sum(keep, axis=0)
-      remaining = remaining * (1 - jax.nn.one_hot(idx, E))
-    dispatch_mask = sum(dispatch_list).astype(x.dtype)      # [T, E, C]
-    combine_mask = sum(combine_list).astype(x.dtype)
+    dispatch_mask, combine_mask, assign = _top_k_dispatch(
+        probs, self.top_k, E, capacity, x.dtype)            # [T, E, C]
 
     # --- Dispatch: [T,D] x [T,E,C] -> [E,C,D] (GSPMD: all-to-all) --------
     expert_in = jnp.einsum("td,tec->ecd", tokens, dispatch_mask)
@@ -118,12 +151,111 @@ class MoEMLP(nn.Module):
     # --- Load-balancing aux loss (Switch eq. 4) --------------------------
     # Uses the router's PRE-capacity assignments: with post-drop counts,
     # the worse the overflow, the weaker the penalty would look.
-    frac_tokens = jnp.mean(
-        sum(assign_list).astype(jnp.float32), axis=0)             # [E]
+    frac_tokens = jnp.mean(assign.astype(jnp.float32), axis=0)    # [E]
     frac_probs = jnp.mean(probs, axis=0)                          # [E]
     aux = E * jnp.sum(frac_tokens * frac_probs)
     self.sow("losses", "moe_aux_loss", aux,
              init_fn=lambda: jnp.float32(0),
              reduce_fn=lambda a, b: a + b)
 
+    return out.reshape(B, S, D)
+
+  def _a2a_path(self, x):
+    """Explicit expert-parallel dispatch via two all_to_all rounds."""
+    from easyparallellibrary_tpu.env import Env
+
+    cfg = self.cfg
+    B, S, D = x.shape
+    E = cfg.num_experts
+    F = cfg.d_ff
+    T = B * S
+    mesh = Env.get().cluster.mesh
+    if constants.EXPERT_AXIS not in mesh.axis_names:
+      raise ValueError(
+          f"moe_impl='a2a' requires a mesh with an "
+          f"{constants.EXPERT_AXIS!r} axis (got {mesh.axis_names}); "
+          f"build it via Cluster.build_mesh(expert=N)")
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    ep = sizes[constants.EXPERT_AXIS]
+    if E % ep:
+      raise ValueError(f"num_experts {E} must divide the expert axis {ep}")
+    if T % ep:
+      raise ValueError(f"tokens per step {T} must divide the expert axis "
+                       f"{ep} (a2a dispatch shards tokens over it)")
+    t_loc = T // ep
+    E_loc = E // ep
+    # Per-SOURCE-device capacity; total receive buffer per expert is
+    # ep * C ~= capacity_factor * T / E (the einsum path's global bound).
+    C = max(self.top_k, int(math.ceil(t_loc / E * cfg.capacity_factor)))
+
+    router_kernel = self.param(
+        "router_kernel",
+        nn.with_partitioning(nn.initializers.normal(stddev=0.02),
+                             (None, None)),
+        (D, E), jnp.float32)
+    model_axis = constants.MODEL_AXIS if cfg.tensor_parallel else None
+    wi = self.param(
+        "wi", nn.with_partitioning(
+            nn.initializers.lecun_normal(),
+            (constants.EXPERT_AXIS, None, model_axis)),
+        (E, D, F), cfg.param_dtype)
+    wo = self.param(
+        "wo", nn.with_partitioning(
+            nn.initializers.lecun_normal(),
+            (constants.EXPERT_AXIS, model_axis, None)),
+        (E, F, D), cfg.param_dtype)
+
+    top_k, dtype = self.top_k, x.dtype
+
+    def local_moe(x_loc, rk, wi_loc, wo_loc):
+      # x_loc: [t_loc, D] this device's token shard; wi/wo: local expert
+      # slices [E_loc, D, F] / [E_loc, F, D].
+      probs = jax.nn.softmax(
+          jnp.matmul(x_loc.astype(jnp.float32), rk), axis=-1)
+      dispatch, combine, assign = _top_k_dispatch(
+          probs, top_k, E, C, dtype)                       # [t_loc, E, C]
+
+      # Dispatch round: pack per-destination-expert buffers and exchange.
+      buf = jnp.einsum("td,tec->ecd", x_loc, dispatch)     # [E, C, D]
+      buf = buf.reshape(ep, E_loc, C, D)
+      recv = jax.lax.all_to_all(buf, constants.EXPERT_AXIS, 0, 0,
+                                tiled=False)               # [ep, E_loc, C, D]
+      # Local experts over all peers' tokens: [E_loc, ep*C, D].
+      h = jnp.einsum("egd,edf->egf",
+                     recv.transpose(1, 0, 2, 3).reshape(E_loc, ep * C, D),
+                     jnp.asarray(wi_loc, dtype))
+      h = nn.gelu(h)
+      y = jnp.einsum("egf,efd->egd", h, jnp.asarray(wo_loc, dtype))
+      # Combine round: send results back to the source devices.
+      y = y.reshape(E_loc, ep, C, D).transpose(1, 0, 2, 3)
+      back = jax.lax.all_to_all(y, constants.EXPERT_AXIS, 0, 0,
+                                tiled=False)               # [ep, E_loc, C, D]
+      out = jnp.einsum("ecd,tec->td", back.reshape(E, C, D), combine)
+
+      # Aux loss over GLOBAL routing statistics: pmean the fractions
+      # FIRST, then form the product — mean-of-products would diverge
+      # from the einsum path whenever routing varies across the token
+      # shards (equal token counts make the pmean the exact global mean).
+      frac_tokens = jax.lax.pmean(
+          jnp.mean(assign.astype(jnp.float32), axis=0),
+          constants.EXPERT_AXIS)
+      frac_probs = jax.lax.pmean(jnp.mean(probs, axis=0),
+                                 constants.EXPERT_AXIS)
+      aux = E * jnp.sum(frac_tokens * frac_probs)
+      return out, aux
+
+    mapped = jax.shard_map(
+        local_moe, mesh=mesh,
+        in_specs=(P(constants.EXPERT_AXIS), P(),
+                  P(constants.EXPERT_AXIS), P(constants.EXPERT_AXIS)),
+        out_specs=(P(constants.EXPERT_AXIS), P()),
+        axis_names=frozenset({constants.EXPERT_AXIS}),
+        check_vma=False)
+    # jit here is inlined under an outer jit; it also makes EAGER
+    # evaluation (flax init) work — jax 0.9's eager shard_map
+    # mis-validates out_specs when axis_names is a subset of the mesh.
+    out, aux = jax.jit(mapped)(x.reshape(T, D), router_kernel, wi, wo)
+    self.sow("losses", "moe_aux_loss", aux,
+             init_fn=lambda: jnp.float32(0),
+             reduce_fn=lambda a, b: a + b)
     return out.reshape(B, S, D)
