@@ -284,11 +284,17 @@ class SequenceConfig(_Category):
       # runs the Pallas kernel per device (no [S, S] scores), "einsum"
       # keeps the pure sharding-constraint formulation.
       "ulysses_impl": "flash",
-      # Causal ring block layout: "contiguous" (block i on device i) or
-      # "zigzag" (half-chunks i and 2n-1-i on device i) — balances the
-      # causal mask so every device does uniform half-block work each
-      # step, cutting causal ring compute ~2x.  Flash ring only.
-      "ring_layout": "contiguous",
+      # Causal ring block layout: "zigzag" (default — half-chunks i and
+      # 2n-1-i on device i) balances the causal mask so every device
+      # does uniform half-block work each step, cutting causal ring
+      # compute ~2x; measured 1.65x fwd+bwd compiled (dense blocks, CPU
+      # mesh) and 1.54x interpret-mode (benchmarks/ring_layout.py,
+      # BASELINE.md round 4) — hence the default.  "contiguous" (block i
+      # on device i) is the fallback; non-causal rings and odd
+      # per-device splits automatically use contiguous behavior, and
+      # flash blocks additionally require tileable half-blocks (dense
+      # blocks have no tiling bound).  shard_map ring only.
+      "ring_layout": "zigzag",
   }
 
 
@@ -373,9 +379,9 @@ class Config:
         "", constants.SEQ_PARALLEL_RING, constants.SEQ_PARALLEL_ULYSSES):
       raise ValueError("sequence.parallelism must be '', 'ring' or "
                        f"'ulysses'; got {self.sequence.parallelism!r}")
-    if self.sequence.ring_impl not in ("flash", "einsum"):
-      raise ValueError("sequence.ring_impl must be 'flash' or 'einsum'; "
-                       f"got {self.sequence.ring_impl!r}")
+    if self.sequence.ring_impl not in ("flash", "einsum", "dense"):
+      raise ValueError("sequence.ring_impl must be 'flash', 'einsum' or "
+                       f"'dense'; got {self.sequence.ring_impl!r}")
     if self.sequence.ulysses_impl not in ("flash", "einsum"):
       raise ValueError("sequence.ulysses_impl must be 'flash' or "
                        f"'einsum'; got {self.sequence.ulysses_impl!r}")

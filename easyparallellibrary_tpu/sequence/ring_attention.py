@@ -127,11 +127,92 @@ def _rot(x, n):
                           [(i, (i + 1) % n) for i in range(n)])
 
 
+# ---------------------------------------------------- block-compute impl --
+#
+# The shard_map ring's per-block attention is pluggable:
+# ``sequence.ring_impl="flash"`` (default) uses the Pallas kernels;
+# "dense" uses plain XLA einsums with the SAME (o, lse8) contract — the
+# pallas-free fallback, and the fully-COMPILED measurement path for the
+# layout benchmarks (pallas on CPU only runs in interpret mode, so
+# interpret-free CPU evidence needs this).
+
+
+def _use_dense_blocks() -> bool:
+  return Env.get().config.sequence.ring_impl == "dense"
+
+
+def _dense_scores(q, k, causal):
+  """Scaled (and causally masked) fp32 score block — shared by the dense
+  fwd and bwd so mask/scale semantics can never drift between them.
+  Matmul operands stay in storage dtype with fp32 accumulation (the
+  kernels' MXU recipe)."""
+  scale = q.shape[-1] ** -0.5
+  s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                 preferred_element_type=jnp.float32) * scale
+  if causal:
+    Sq, Sk = s.shape[-2], s.shape[-1]
+    mask = jnp.arange(Sq)[:, None] >= jnp.arange(Sk)[None, :]
+    s = jnp.where(mask, s, NEG_INF)
+  return s, scale
+
+
+def _dense_block_fwd(q, k, v, causal):
+  """XLA block attention with `_fwd`'s contract: ([B,H,S,D] in q.dtype,
+  lse8 [B,H,8,S] fp32); softmax fp32."""
+  s, _ = _dense_scores(q, k, causal)
+  m = jnp.max(s, axis=-1)
+  p = jnp.exp(s - m[..., None])
+  l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
+  o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                 preferred_element_type=jnp.float32) / l[..., None]
+  lse = m + jnp.log(l)
+  lse8 = jnp.broadcast_to(lse[:, :, None, :],
+                          lse.shape[:2] + (8,) + lse.shape[-1:])
+  return o.astype(q.dtype), lse8
+
+
+def _dense_block_bwd(q, k, v, dout, lse8, delta8, causal):
+  """XLA twin of `_bwd_kernels`: block backward against the GLOBAL
+  logsumexp/delta (p = exp(s - L) is globally normalized, so dk/dv
+  accumulate additively across ring steps).  Matmul operands stay in
+  storage dtype with fp32 accumulation — full-fp32 matmuls are ~4x
+  slower on the MXU (measured note in kernels/flash_attention.py)."""
+  lse = lse8[:, :, 0, :]
+  delta = delta8[:, :, 0, :]
+  s, scale = _dense_scores(q, k, causal)
+  p = jnp.exp(s - lse[..., None])                       # masked -> 0
+  pc = p.astype(dout.dtype)
+  dv = jnp.einsum("bhqk,bhqd->bhkd", pc, dout,
+                  preferred_element_type=jnp.float32)
+  dp = jnp.einsum("bhqd,bhkd->bhqk", dout, v,
+                  preferred_element_type=jnp.float32)
+  ds = (p * (dp - delta[..., None])).astype(q.dtype)
+  dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k,
+                  preferred_element_type=jnp.float32) * scale
+  dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q,
+                  preferred_element_type=jnp.float32) * scale
+  return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _block_fwd(q, k, v, causal, bq, bk):
+  if _use_dense_blocks():
+    return _dense_block_fwd(q, k, v, causal)
+  from easyparallellibrary_tpu.kernels.flash_attention import _fwd
+  return _fwd(q, k, v, causal, bq, bk)
+
+
+def _block_bwd(q, k, v, dout, lse8, delta8, causal, bq, bk):
+  if _use_dense_blocks():
+    return _dense_block_bwd(q, k, v, dout, lse8, delta8, causal)
+  from easyparallellibrary_tpu.kernels.flash_attention import _bwd_kernels
+  return _bwd_kernels(q, k, v, dout, lse8, delta8, causal, bq, bk)
+
+
 def _ring_fwd_pass(n, causal, q, k0, v0):
   """Per-device ring forward in kernel layout [B, H, s, D].  Returns the
   merged (O fp32, L fp32 [B, H, s])."""
   from easyparallellibrary_tpu.kernels.flash_attention import (
-      _default_block, _fwd)
+      _default_block)
   s = q.shape[2]
   bq = bk = _default_block(s, d=q.shape[3],
                            itemsize=q.dtype.itemsize)
@@ -140,7 +221,8 @@ def _ring_fwd_pass(n, causal, q, k0, v0):
   L = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
   k_cur, v_cur = k0, v0
   for r in range(n):
-    o_r, lse8 = _fwd(q, k_cur, v_cur, causal and r == 0, bq, bk)
+    o_r, lse8 = _block_fwd(q, k_cur, v_cur, causal and r == 0,
+                           bq, bk)
     lse_r = lse8[:, :, 0, :]
     if causal and r > 0:
       # Device idx holds KV block (idx - r) mod n at step r: wrapped
@@ -179,7 +261,7 @@ def _ring_local_fwd(n, causal, q, k0, v0):
 
 def _ring_local_bwd(n, causal, residuals, dO):
   from easyparallellibrary_tpu.kernels.flash_attention import (
-      _bwd_kernels, _default_block, _tile8)
+      _default_block, _tile8)
   q, k0, v0, O, L = residuals
   s = q.shape[2]
   bq = bk = _default_block(s, d=q.shape[3],
@@ -193,8 +275,8 @@ def _ring_local_bwd(n, causal, residuals, dO):
   dk_cur = jnp.zeros(k0.shape, jnp.float32)
   dv_cur = jnp.zeros(v0.shape, jnp.float32)
   for r in range(n):
-    dq_r, dk_r, dv_r = _bwd_kernels(q, k_cur, v_cur, dO, L8, delta8,
-                                    causal and r == 0, bq, bk)
+    dq_r, dk_r, dv_r = _block_bwd(q, k_cur, v_cur, dO, L8, delta8,
+                                  causal and r == 0, bq, bk)
     if causal and r > 0:
       masked = idx < r
       dq_r = jnp.where(masked, jnp.zeros_like(dq_r), dq_r)
@@ -283,7 +365,7 @@ def _zz_fwd_pass(n, q, k0, v0):
   """Zigzag causal ring forward ([B, H, s, D] locals, s = 2 half-chunks).
   Returns merged (O fp32, L fp32)."""
   from easyparallellibrary_tpu.kernels.flash_attention import (
-      _default_block, _fwd)
+      _default_block)
   half = q.shape[2] // 2
   bq = bk = _default_block(half, d=q.shape[3],
                            itemsize=q.dtype.itemsize)
@@ -291,7 +373,7 @@ def _zz_fwd_pass(n, q, k0, v0):
   qa, qb = _halves(q)
 
   def fwd_half(qh, kh, vh, causal):
-    o, lse8 = _fwd(qh, kh, vh, causal, bq, bk)
+    o, lse8 = _block_fwd(qh, kh, vh, causal, bq, bk)
     return o.astype(jnp.float32), lse8[:, :, 0, :]
 
   O = jnp.zeros(q.shape, jnp.float32)
@@ -348,7 +430,7 @@ def _ring_local_zz_bwd(n, residuals, dO):
   GLOBAL per-half logsumexp, and dk/dv halves accumulating as their
   block rides the ring home."""
   from easyparallellibrary_tpu.kernels.flash_attention import (
-      _bwd_kernels, _default_block, _tile8)
+      _default_block, _tile8)
   q, k0, v0, O, L = residuals
   half = q.shape[2] // 2
   bq = bk = _default_block(half, d=q.shape[3],
@@ -369,7 +451,7 @@ def _ring_local_zz_bwd(n, residuals, dO):
   dv_cur = jnp.zeros(v0.shape, jnp.float32)
 
   def bwd_half(qh, kh, vh, dOh, L8, d8, causal):
-    return _bwd_kernels(qh, kh, vh, dOh, L8, d8, causal, bq, bk)
+    return _block_bwd(qh, kh, vh, dOh, L8, d8, causal, bq, bk)
 
   for r in range(n):
     ka, kb = _halves(k_cur)
@@ -435,8 +517,9 @@ def _ring_flash(q, k, v, causal: bool):
       flash_blockable)
   zigzag = (env.config.sequence.ring_layout == "zigzag" and causal
             and n > 1 and (S // n) % 2 == 0
-            and flash_blockable(S // n // 2, d=D,
-                                itemsize=q.dtype.itemsize))
+            and (_use_dense_blocks()
+                 or flash_blockable(S // n // 2, d=D,
+                                    itemsize=q.dtype.itemsize)))
 
   def local(q_l, k_l, v_l):
     qt = q_l.transpose(0, 2, 1, 3)
@@ -475,14 +558,16 @@ def ring_attention(q, k, v, causal: bool = True,
   B, S, H, D = q.shape
   axis = max(_seq_axis_size(), 1)
   seq_cfg = Env.get().config.sequence
-  if (axis > 1 and num_blocks is None and seq_cfg.ring_impl == "flash"
+  if (axis > 1 and num_blocks is None
+      and seq_cfg.ring_impl in ("flash", "dense")
       and not seq_cfg.block_size):  # finer blocking → einsum path
     if S % axis:
       raise ValueError(f"sequence length {S} not divisible by "
                        f"{axis} ring devices")
     from easyparallellibrary_tpu.kernels.flash_attention import (
         flash_blockable)
-    if flash_blockable(S // axis, d=D, itemsize=q.dtype.itemsize):
+    if seq_cfg.ring_impl == "dense" or flash_blockable(
+        S // axis, d=D, itemsize=q.dtype.itemsize):
       return _ring_flash(q, k, v, causal)
     # Per-device block length the kernels can't tile (no power-of-two
     # divisor <= 512): fall through to the einsum formulation rather

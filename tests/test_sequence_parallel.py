@@ -329,3 +329,41 @@ def test_unblockable_lengths_fall_back_to_einsum():
       q, k, v)
   np.testing.assert_allclose(np.asarray(out_u), np.asarray(ref),
                              rtol=2e-5, atol=2e-6)
+
+
+def test_dense_ring_matches_full_attention_both_layouts():
+  """`sequence.ring_impl="dense"` (plain-XLA blocks — the pallas-free
+  fallback and the compiled measurement path for the layout benchmarks)
+  matches full attention, fwd and grad, under both causal layouts.
+  Round-4 note: ring_layout now DEFAULTS to zigzag (1.65x compiled win,
+  BASELINE.md)."""
+  for layout in ("contiguous", "zigzag"):
+    epl.init(epl.Config({"sequence.parallelism": "ring",
+                         "sequence.axis_size": 8,
+                         "sequence.ring_impl": "dense",
+                         "sequence.ring_layout": layout}))
+    epl.current_plan().build_mesh()
+    B, S, H, D = 1, 128, 4, 16
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(r.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(r.randn(B, S, H, D), jnp.float32)
+
+    def full(q):
+      s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+      mask = jnp.tril(jnp.ones((S, S), bool))
+      s = jnp.where(mask[None, None], s, -1e30)
+      return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+
+    out = jax.jit(lambda q: ring_attention(q, k, v, causal=True))(q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full(q)),
+                               rtol=1e-4, atol=1e-5)
+    g1 = jax.jit(jax.grad(
+        lambda q: jnp.sum(ring_attention(q, k, v, causal=True) ** 2)))(q)
+    g2 = jax.jit(jax.grad(lambda q: jnp.sum(full(q) ** 2)))(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_ring_layout_default_is_zigzag():
+  assert epl.Config().sequence.ring_layout == "zigzag"
