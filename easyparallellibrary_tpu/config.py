@@ -287,7 +287,7 @@ class SequenceConfig(_Category):
       # Causal ring block layout: "zigzag" (default — half-chunks i and
       # 2n-1-i on device i) balances the causal mask so every device
       # does uniform half-block work each step, cutting causal ring
-      # compute ~2x; measured 1.65x fwd+bwd compiled (dense blocks, CPU
+      # compute ~2x; measured 1.84x fwd+bwd compiled (dense blocks, CPU
       # mesh) and 1.54x interpret-mode (benchmarks/ring_layout.py,
       # BASELINE.md round 4) — hence the default.  "contiguous" (block i
       # on device i) is the fallback; non-causal rings and odd

@@ -39,6 +39,7 @@ tests exercise identical code paths on CPU.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -650,6 +651,46 @@ def flash_attention_lse(q, k, v, causal: bool = True,
   return out.transpose(0, 2, 1, 3), lse.transpose(0, 2, 1)
 
 
+# Autotuned block widths: {(S, d, itemsize): want}, loaded lazily from
+# flash_block_table.json next to this module when present (written by
+# benchmarks/flash_autotune.py on real hardware; format
+# {"device": <device_kind>, "entries": {"S:d:itemsize": want}}).
+# Entries override the 512/1024 heuristic for their exact shape ONLY
+# when the file's device kind matches the current backend — widths
+# tuned for one TPU generation must not silently apply to another (or
+# to CPU test runs).  Loading is lazy because it consults
+# jax.devices(), which must not run at import time.
+_BLOCK_TABLE: Optional[dict] = None
+_BLOCK_TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(
+    __file__)), "flash_block_table.json")
+
+
+def _ensure_block_table() -> dict:
+  global _BLOCK_TABLE
+  if _BLOCK_TABLE is not None:
+    return _BLOCK_TABLE
+  _BLOCK_TABLE = {}
+  try:
+    with open(_BLOCK_TABLE_PATH) as f:
+      raw = __import__("json").load(f)
+    entries = raw.get("entries") if isinstance(raw, dict) else None
+    device = raw.get("device") if isinstance(raw, dict) else None
+    if isinstance(entries, dict) and device == jax.devices()[0].device_kind:
+      for key, want in entries.items():
+        s_, d_, it_ = (int(x) for x in key.split(":"))
+        _BLOCK_TABLE[(s_, d_, it_)] = int(want)
+  except Exception:
+    # Any malformed/foreign table falls back to the heuristic silently —
+    # the table is an optimization, never a correctness dependency.
+    _BLOCK_TABLE = {}
+  return _BLOCK_TABLE
+
+
+def set_block_want(S: int, d: int, itemsize: int, want: int) -> None:
+  """Programmatic autotune-table entry (benchmarks/flash_autotune.py)."""
+  _ensure_block_table()[(S, d, itemsize)] = int(want)
+
+
 def _default_block(S: int, want: int = 0, *, d: int,
                    itemsize: int = 2) -> int:
   """Largest block <= `want` that divides S (halving from `want`, floor
@@ -657,13 +698,16 @@ def _default_block(S: int, want: int = 0, *, d: int,
   0 when NO such block divides S (e.g. S = 515) — callers must either
   raise or fall back to a non-kernel path, never truncate the grid.
 
-  Default `want`: 512 in the resident regime, 1024 once S·d is long
+  Default `want`: the autotuned table entry for (S, d, itemsize) when
+  one exists, else 512 in the resident regime and 1024 once S·d is long
   enough that the streaming kernels kick in (wider blocks amortize the
   ~0.3 us/grid-step overhead that otherwise dominates: measured 1.4x at
   S=4096-8192 over 512 blocks).  `d` must match the head dim the kernel
   will run with so this agrees with `_resident_ok`'s dispatch."""
   if not want:
-    want = 512 if S * d * itemsize <= _RESIDENT_MAX_BYTES else 1024
+    want = _ensure_block_table().get((S, d, itemsize))
+    if not want:
+      want = 512 if S * d * itemsize <= _RESIDENT_MAX_BYTES else 1024
   if S <= want:
     return S
   b = want
