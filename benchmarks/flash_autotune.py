@@ -96,9 +96,11 @@ def main():
   # previously-tuned width that no longer beats the heuristic is
   # dropped, and re-runs never compare against their own prior output).
   old_entries = {}
+  had_file = False
   try:
     with open(fa._BLOCK_TABLE_PATH) as f:
       raw = json.load(f)
+    had_file = True
     if isinstance(raw, dict) and raw.get("device") == device \
         and isinstance(raw.get("entries"), dict):
       old_entries = dict(raw["entries"])
@@ -115,8 +117,8 @@ def main():
     q, k, v = mk(), mk(), mk()
     # Default from the HEURISTIC, not the loaded table — comparing
     # against our own prior output would silently drop valid entries.
-    heur = 512 if S * D * 2 <= fa._RESIDENT_MAX_BYTES else 1024
-    default_want = fa._default_block(S, heur, d=D, itemsize=2)
+    default_want = fa._default_block(S, fa._heuristic_want(S, D, 2),
+                                     d=D, itemsize=2)
     times = {}
     for want in CANDIDATES:
       try:
@@ -143,7 +145,9 @@ def main():
       table[f"{S}:{D}:2"] = best_want
 
   final = {**old_entries, **table}
-  if final:
+  if final or had_file:
+    # Rewrite even when empty: a re-run that rejects every prior entry
+    # must not leave the stale table serving rejected widths.
     with open(fa._BLOCK_TABLE_PATH, "w") as f:
       json.dump({"device": device, "entries": final}, f, indent=1)
   print(json.dumps({

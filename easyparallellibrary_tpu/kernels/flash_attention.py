@@ -691,6 +691,13 @@ def set_block_want(S: int, d: int, itemsize: int, want: int) -> None:
   _ensure_block_table()[(S, d, itemsize)] = int(want)
 
 
+def _heuristic_want(S: int, d: int, itemsize: int) -> int:
+  """The untuned block-width default: 512 in the resident regime, 1024
+  once the streaming kernels kick in.  Single source of truth — the
+  autotune benchmark compares its candidates against THIS."""
+  return 512 if S * d * itemsize <= _RESIDENT_MAX_BYTES else 1024
+
+
 def _default_block(S: int, want: int = 0, *, d: int,
                    itemsize: int = 2) -> int:
   """Largest block <= `want` that divides S (halving from `want`, floor
@@ -707,7 +714,7 @@ def _default_block(S: int, want: int = 0, *, d: int,
   if not want:
     want = _ensure_block_table().get((S, d, itemsize))
     if not want:
-      want = 512 if S * d * itemsize <= _RESIDENT_MAX_BYTES else 1024
+      want = _heuristic_want(S, d, itemsize)
   if S <= want:
     return S
   b = want

@@ -738,7 +738,17 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
         "interleaved-1F1B schedule (pipeline.strategy PreferBackward*); "
         "GPipe order does not interleave chunks")
   if cfg.num_experts > 0:
-    raise ValueError("MoE on the smap engine is not supported yet")
+    if cfg.moe_impl == "a2a":
+      raise ValueError(
+          "moe_impl='a2a' nests a second shard_map inside the smap "
+          "pipeline engine and is not supported there; use the default "
+          "moe_impl='einsum' (GSPMD handles the expert axis inside the "
+          "stage program) or a vmapped engine")
+    if cfg.num_layers % (S * K) != 0:
+      raise ValueError(
+          f"num_layers={cfg.num_layers} must divide evenly into "
+          f"{S * K} stages/chunks when MoE is enabled (matches the "
+          f"model's own constraint, GPT.__call__)")
   if cfg.vocab_size % S:
     raise ValueError(f"vocab_size {cfg.vocab_size} must divide into "
                      f"{S} stage-resident shards")
@@ -771,11 +781,14 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
         p["wpe"][None, :ids.shape[1]].astype(cfg.dtype)
 
   def stage_fn(p, x, rng, chunk=None):
-    """One stage's blocks.  `chunk` (interleaved only) is the LOCAL
-    chunk index; the params tree then carries the K passes stacked on
-    axis 1 of each stacked leaf ([1, K, ...] per device) and the block
-    row is dynamically selected — the dynamic index transposes to the
-    right gradient rows automatically."""
+    """One stage's blocks -> (y, aux_scalar).  `chunk` (interleaved
+    only) is the LOCAL chunk index; the params tree then carries the K
+    passes stacked on axis 1 of each stacked leaf ([1, K, ...] per
+    device) and the block row is dynamically selected — the dynamic
+    index transposes to the right gradient rows automatically.  MoE
+    blocks follow the same local-index pattern as StageBlocks and
+    return their sown load-balancing losses through `aux` (the engines
+    weight it by stage_aux_weight = cfg.moe_aux_weight)."""
     s_idx = jax.lax.axis_index(constants.STAGE_AXIS)
     row = p["pipeline"]["stages"]["stacked"]
     train = cfg.dropout_rate > 0 and rng is not None
@@ -786,25 +799,37 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
       sel = lambda l: jax.lax.dynamic_index_in_dim(l[0], chunk, 0,
                                                    keepdims=False)
       v_idx = chunk * S + s_idx  # virtual stage = layer-order chunk id
+    aux = jnp.float32(0)
     for i in range(blocks_per_stage):
       bp = jax.tree_util.tree_map(sel, row[f"block_{i}"])
-      blk = Block(cfg, use_moe=False, deterministic=not train)
+      use_moe = cfg.num_experts > 0 and \
+          (i % cfg.moe_every == cfg.moe_every - 1)
+      blk = Block(cfg, use_moe=use_moe, deterministic=not train)
 
-      def apply_blk(xx, bp=bp, blk=blk, i=i):
+      def apply_blk(xx, bp=bp, blk=blk, i=i, use_moe=use_moe):
         rngs = ({"dropout": jax.random.fold_in(rng, i)}
                 if train else None)
-        return blk.apply({"params": bp}, xx, rngs=rngs)
+        if use_moe:
+          yy, state = blk.apply({"params": bp}, xx, rngs=rngs,
+                                mutable=["losses"])
+          leaves = jax.tree_util.tree_leaves(state.get("losses", {}))
+          a = (sum(jnp.sum(l) for l in leaves) if leaves
+               else jnp.float32(0))
+          return yy, jnp.asarray(a, jnp.float32)
+        return blk.apply({"params": bp}, xx, rngs=rngs), jnp.float32(0)
 
       if cfg.remat:
         apply_blk = jax.checkpoint(apply_blk, policy=policy,
                                    prevent_cse=False)
       if n_active_arr is None:
-        x = apply_blk(x)
+        x, a_i = apply_blk(x)
       else:
         # Real branch under shard_map: a masked slot costs nothing.
-        x = jax.lax.cond(i < n_active_arr[v_idx], apply_blk,
-                         lambda xx: xx, x)
-    return x
+        x, a_i = jax.lax.cond(
+            i < n_active_arr[v_idx], apply_blk,
+            lambda xx: (xx, jnp.float32(0)), x)
+      aux = aux + a_i
+    return x, aux
 
   def emit_fn(p, y, mb, valid, rng):
     h = ln_f.apply({"params": p["ln_f"]}, y)
@@ -872,18 +897,19 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
           lambda _: P(constants.STAGE_AXIS),
           un["pipeline"]["stages"]["stacked"])
       manual = frozenset({constants.STAGE_AXIS, constants.DATA_AXIS})
+      aux_w = cfg.moe_aux_weight if cfg.num_experts > 0 else 0.0
       if schedule == "interleaved":
         from easyparallellibrary_tpu.parallel.pipeline_interleaved import (
             make_smap_interleaved_grad_fn)
         engine_cache["fn"] = make_smap_interleaved_grad_fn(
             feed_fn, stage_fn, emit_fn, S, K, M, mesh, specs,
-            manual_axes=manual)
+            manual_axes=manual, stage_aux_weight=aux_w)
       else:
         build = (make_smap_1f1b_grad_fn if schedule == "1f1b"
                  else make_smap_gpipe_grad_fn)
         engine_cache["fn"] = build(
             feed_fn, stage_fn, emit_fn, S, M, mesh, specs,
-            manual_axes=manual)
+            manual_axes=manual, stage_aux_weight=aux_w)
     ids = batch["ids"]
     mbs = split_micro_batches(
         {"inputs": ids[:, :-1], "targets": ids[:, 1:]}, M)
@@ -900,6 +926,10 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
         if isinstance(box, nn.meta.AxisMetadata) else gg,
         params, g,
         is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata))
+    metrics = dict(metrics)
+    aux_metric = metrics.pop("stage_aux_loss", None)
+    if cfg.num_experts > 0 and aux_metric is not None:
+      metrics["moe_aux_loss"] = aux_metric
     return (loss, metrics), grads
 
   return grad_fn

@@ -271,7 +271,8 @@ def make_smap_interleaved_grad_fn(feed_fn: Callable,
                                   param_specs,
                                   *,
                                   batch_spec: Optional[P] = None,
-                                  manual_axes: Optional[frozenset] = None
+                                  manual_axes: Optional[frozenset] = None,
+                                  stage_aux_weight: float = 0.0
                                   ) -> Callable:
   """Interleaved-1F1B shard_map pipeline gradient function.
 
@@ -350,7 +351,8 @@ def make_smap_interleaved_grad_fn(feed_fn: Callable,
       return jax.lax.dynamic_index_in_dim(row, s_idx, 0, keepdims=False)
 
     def tick(carry, row):
-      Ysend, Bsend, InBuf, Res, CotBuf, G, loss_sum = carry
+      (Ysend, Bsend, InBuf, Res, CotBuf, G, loss_sum,
+       aux_sum) = carry
 
       # ---- forward receive: buffer the arriving boundary activation.
       x_recv = jax.lax.ppermute(Ysend, constants.STAGE_AXIS, ring_f)
@@ -371,9 +373,10 @@ def make_smap_interleaved_grad_fn(feed_fn: Callable,
       x_in = jnp.where(is_feed, x_fed,
                        buf_read(InBuf, jf, jnp.mod(mf, W)))
       Res = buf_write(Res, x_in, jf, jnp.mod(mf, W), vf)
-      Y = jax.lax.cond(
+      Y, aux_s = jax.lax.cond(
           vf, lambda op: stage_fn(params, op, st_rng(mf, jf), jf),
-          lambda op: op, x_in)
+          lambda op: (op, jnp.float32(0)), x_in)
+      aux_sum = aux_sum + jnp.where(vf, aux_s, 0.0)
 
       # ---- emit: the final virtual stage's output leaves the pipe.
       ev = row["emit_valid"]
@@ -414,7 +417,9 @@ def make_smap_interleaved_grad_fn(feed_fn: Callable,
         r = st_rng(mbb, jb)
         _, vjp = jax.vjp(
             lambda p, xx: stage_fn(p, xx, r, jb), params, x_res)
-        return vjp(cot)
+        # Aux cotangent seeded at its objective weight (x AMP seed);
+        # the final 1/M rescale covers the rest (vmap-engine recipe).
+        return vjp((cot, jnp.float32(stage_aux_weight) * seed))
 
       def bwd_zero(_):
         return zeros_g, jnp.zeros_like(x_res)
@@ -435,13 +440,14 @@ def make_smap_interleaved_grad_fn(feed_fn: Callable,
       (dFp,) = feed_vjp(ct_feed)
       G = jax.tree_util.tree_map(jnp.add, G, dFp)
 
-      return (Y, dX, InBuf, Res, CotBuf, G, loss_sum), None
+      return (Y, dX, InBuf, Res, CotBuf, G, loss_sum, aux_sum), None
 
     buf0 = jnp.zeros((K, W) + x0.shape, x0.dtype)
     carry0 = (zeros_x, jnp.zeros_like(zeros_x), buf0, buf0, buf0,
-              zeros_g, jnp.zeros((), jnp.float32))
+              zeros_g, jnp.zeros((), jnp.float32),
+              jnp.zeros((), jnp.float32))
     (final, _) = jax.lax.scan(tick, carry0, xs)
-    (_, _, _, _, _, G, loss_sum) = final
+    (_, _, _, _, _, G, loss_sum, aux_sum) = final
 
     g_scale = jnp.float32(1.0 / M) / seed
     G = jax.tree_util.tree_map(lambda g: g * g_scale.astype(g.dtype), G)
@@ -452,13 +458,22 @@ def make_smap_interleaved_grad_fn(feed_fn: Callable,
       return jax.lax.pmean(g, constants.DATA_AXIS)
 
     G = jax.tree_util.tree_map(reduce_leaf, G, stage_psum)
-    loss = jax.lax.pmean(loss_sum / M, constants.DATA_AXIS)
-    return (loss, {}), G
+    loss_local = loss_sum / M
+    if stage_aux_weight:
+      aux_total = jax.lax.psum(aux_sum, constants.STAGE_AXIS) / M
+      loss_local = loss_local + jnp.float32(stage_aux_weight) * aux_total
+    else:
+      # Keep the non-aux hot path free of the reporting psum.
+      aux_total = jnp.float32(0)
+    loss = jax.lax.pmean(loss_local, constants.DATA_AXIS)
+    metrics = {"stage_aux_loss": jax.lax.pmean(aux_total,
+                                               constants.DATA_AXIS)}
+    return (loss, metrics), G
 
   mapped = jax.shard_map(
       local_grad, mesh=mesh,
       in_specs=(param_specs, bspec, P(), P()),
-      out_specs=((P(), {}), param_specs),
+      out_specs=((P(), {"stage_aux_loss": P()}), param_specs),
       axis_names=manual_axes if manual_axes is not None else frozenset(),
       check_vma=False)
 

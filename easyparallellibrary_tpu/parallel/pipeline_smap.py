@@ -161,6 +161,7 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
                             *,
                             batch_spec: Optional[P] = None,
                             manual_axes: Optional[frozenset] = None,
+                            stage_aux_weight: float = 0.0,
                             check_specs=None) -> Callable:
   """Build the shard_map pipeline gradient function.
 
@@ -173,10 +174,15 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
         psum over the stage axis (see `vocab_partial_embed`); runs every
         tick on every device (cheap gather + one psum); only stage 0's
         result is consumed.
-    stage_fn(p_loc, x, rng) -> y
+    stage_fn(p_loc, x, rng) -> (y, aux_scalar)
         ONE stage, shape-preserving.  Gated by the engine inside
         lax.cond — bubble ticks never execute it.  Must contain no
-        stage-axis collectives.
+        stage-axis collectives.  `aux_scalar` is a differentiable
+        per-(stage, micro-batch) auxiliary loss (e.g. MoE load
+        balancing; 0.0 when unused) weighted into the objective by
+        `stage_aux_weight` — it is LOCAL to the owning device (unlike
+        the emit loss, which is collective), so the engine psums its
+        total over the stage axis for reporting.
     emit_fn(p_loc, y, mb, valid, rng) -> scalar loss (float32)
         Head + loss for the micro-batch leaving the last stage; `y` is
         the psum-broadcast last-stage output.  Collective over the stage
@@ -211,7 +217,7 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
 
     def local_loss(p):
       def tick(carry, t):
-        y_prev, loss_sum = carry
+        y_prev, loss_sum, aux_sum = carry
         x_recv = jax.lax.ppermute(y_prev, constants.STAGE_AXIS,
                                   _fwd_perm(S))
         m_f = jnp.clip(t, 0, M - 1)
@@ -225,9 +231,10 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
         st_rng = (None if rng is None
                   else jax.random.fold_in(
                       rng, jnp.clip(m_s, 0, M - 1) * S + s_idx))
-        y = jax.lax.cond(valid_f,
-                         lambda op: stage_fn(p, op, st_rng),
-                         lambda op: op, x_in)
+        y, aux_s = jax.lax.cond(
+            valid_f, lambda op: stage_fn(p, op, st_rng),
+            lambda op: (op, jnp.float32(0)), x_in)
+        aux_sum = aux_sum + jnp.where(valid_f, aux_s, 0.0)
 
         y_b = jax.lax.psum(
             jnp.where(s_idx == S - 1, y, jnp.zeros_like(y)),
@@ -240,23 +247,37 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
         loss_e = emit_fn(p, y_b, mb_at(me), valid_e, emit_rng)
         loss_sum = loss_sum + jnp.where(valid_e,
                                         loss_e.astype(jnp.float32), 0.0)
-        return (y, loss_sum), None
+        return (y, loss_sum, aux_sum), None
 
       mb0 = mb_at(0)
       x0 = jax.eval_shape(feed_fn, p, mb0, None)
       y0 = jnp.zeros(x0.shape, x0.dtype)
-      (_, loss_sum), _ = jax.lax.scan(
-          tick, (y0, jnp.zeros((), jnp.float32)), jnp.arange(T))
+      (_, loss_sum, aux_sum), _ = jax.lax.scan(
+          tick, (y0, jnp.zeros((), jnp.float32),
+                 jnp.zeros((), jnp.float32)), jnp.arange(T))
       # The emit loss is computed collectively but lands (identically) on
       # EVERY stage device, and shard_map's psum transposes to psum — so
       # each device must differentiate its 1/S *share* of the objective
       # or every collective-crossing path overcounts by S (probe:
       # tests/test_pipeline_smap.py::test_smap_share_scaling).  The
-      # device-summed objective is then exactly the true loss.
-      return loss_sum / (M * S)
+      # device-summed objective is then exactly the true loss.  The
+      # stage-aux term is LOCAL (only the owning device computes it), so
+      # it enters at full 1/M weight — device-summed it contributes
+      # w * sum_{s,m} aux / M, the vmap engine's objective.
+      obj = loss_sum / (M * S)
+      if stage_aux_weight:
+        obj = obj + jnp.float32(stage_aux_weight) * aux_sum / M
+      return obj, (loss_sum, aux_sum)
 
-    share, grads = jax.value_and_grad(local_loss)(p_loc)
-    loss = share * S
+    (share, (loss_sum, aux_sum)), grads = jax.value_and_grad(
+        local_loss, has_aux=True)(p_loc)
+    loss = loss_sum / M
+    if stage_aux_weight:
+      aux_total = jax.lax.psum(aux_sum, constants.STAGE_AXIS) / M
+      loss = loss + jnp.float32(stage_aux_weight) * aux_total
+    else:
+      # Keep the non-aux hot path free of the reporting psum.
+      aux_total = jnp.float32(0)
 
     # Cross-device grad reductions: stage-replicated leaves carry only
     # this stage's contribution -> psum over stage; everything is
@@ -269,12 +290,14 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
 
     grads = jax.tree_util.tree_map(reduce_leaf, grads, stage_psum)
     loss = jax.lax.pmean(loss, constants.DATA_AXIS)
-    return (loss, {}), grads
+    metrics = {"stage_aux_loss": jax.lax.pmean(aux_total,
+                                               constants.DATA_AXIS)}
+    return (loss, metrics), grads
 
   mapped = jax.shard_map(
       local_grad, mesh=mesh,
       in_specs=(param_specs, bspec, P()),
-      out_specs=((P(), {}), param_specs),
+      out_specs=((P(), {"stage_aux_loss": P()}), param_specs),
       axis_names=manual_axes if manual_axes is not None else frozenset(),
       check_vma=False)
 
@@ -293,7 +316,8 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
                            param_specs,
                            *,
                            batch_spec: Optional[P] = None,
-                           manual_axes: Optional[frozenset] = None
+                           manual_axes: Optional[frozenset] = None,
+                           stage_aux_weight: float = 0.0
                            ) -> Callable:
   """True-1F1B shard_map pipeline gradient function.
 
@@ -346,7 +370,7 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
     zeros_g = jax.tree_util.tree_map(jnp.zeros_like, params)
 
     def tick(carry, t):
-      F, R, Bc, G, loss_sum = carry
+      F, R, Bc, G, loss_sum, aux_sum = carry
 
       # ---- forward sub-tick: this stage advances one micro-batch ----
       m_f = t - s_idx
@@ -363,9 +387,10 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
       R = jnp.where(
           valid_f,
           jax.lax.dynamic_update_index_in_dim(R, x_in, slot_w, 0), R)
-      Y = jax.lax.cond(valid_f,
-                       lambda op: stage_fn(params, op, st_rng(mf)),
-                       lambda op: op, x_in)
+      Y, aux_s = jax.lax.cond(
+          valid_f, lambda op: stage_fn(params, op, st_rng(mf)),
+          lambda op: (op, jnp.float32(0)), x_in)
+      aux_sum = aux_sum + jnp.where(valid_f, aux_s, 0.0)
 
       # ---- emit sub-tick: loss + cotangent for the micro-batch leaving
       # the last stage (its backward starts this tick) ----
@@ -409,7 +434,11 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
       def bwd(_):
         r = st_rng(mbc)
         _, vjp = jax.vjp(lambda p, xx: stage_fn(p, xx, r), params, x_res)
-        return vjp(cot)
+        # Seed the aux output's cotangent with its objective weight
+        # (scaled by the AMP seed like the emit loss; the final 1/M
+        # rescale covers the rest — same recipe as the vmap engine,
+        # schedule_1f1b.py).
+        return vjp((cot, jnp.float32(stage_aux_weight) * seed))
 
       def bwd_zero(_):
         return zeros_g, jnp.zeros_like(x_res)
@@ -430,13 +459,13 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
       (dFp,) = feed_vjp(ct_feed)
       G = jax.tree_util.tree_map(jnp.add, G, dFp)
 
-      return (Y, R, dX, G, loss_sum), None
+      return (Y, R, dX, G, loss_sum, aux_sum), None
 
     R0 = jnp.zeros((W,) + x0.shape, x0.dtype)
     carry0 = (zeros_x, R0, jnp.zeros_like(zeros_x), zeros_g,
-              jnp.zeros((), jnp.float32))
+              jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
     (final, _) = jax.lax.scan(tick, carry0, jnp.arange(T))
-    (_, _, _, G, loss_sum) = final
+    (_, _, _, G, loss_sum, aux_sum) = final
 
     g_scale = jnp.float32(1.0 / M) / seed
     G = jax.tree_util.tree_map(
@@ -448,13 +477,22 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
       return jax.lax.pmean(g, constants.DATA_AXIS)
 
     G = jax.tree_util.tree_map(reduce_leaf, G, stage_psum)
-    loss = jax.lax.pmean(loss_sum / M, constants.DATA_AXIS)
-    return (loss, {}), G
+    loss_local = loss_sum / M
+    if stage_aux_weight:
+      aux_total = jax.lax.psum(aux_sum, constants.STAGE_AXIS) / M
+      loss_local = loss_local + jnp.float32(stage_aux_weight) * aux_total
+    else:
+      # Keep the non-aux hot path free of the reporting psum.
+      aux_total = jnp.float32(0)
+    loss = jax.lax.pmean(loss_local, constants.DATA_AXIS)
+    metrics = {"stage_aux_loss": jax.lax.pmean(aux_total,
+                                               constants.DATA_AXIS)}
+    return (loss, metrics), G
 
   mapped = jax.shard_map(
       local_grad, mesh=mesh,
       in_specs=(param_specs, bspec, P(), P()),
-      out_specs=((P(), {}), param_specs),
+      out_specs=((P(), {"stage_aux_loss": P()}), param_specs),
       axis_names=manual_axes if manual_axes is not None else frozenset(),
       check_vma=False)
 
