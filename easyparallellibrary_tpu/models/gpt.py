@@ -73,11 +73,13 @@ class GPTConfig:
   num_micro_batch: int = 1
   pipeline_schedule: str = ""   # "" = from Config pipeline.strategy
   pipeline_debug_sequential: bool = False  # ground-truth path for tests
-  # Interleaved placement (reference config pipeline.num_stages_per_device):
-  # blocks split into K chained pipeline passes, so each device holds K
-  # non-adjacent block chunks (the circular WEIGHT DISTRIBUTION only; the
-  # bubble fraction is unchanged — true interleaved scheduling is a
-  # deferred item, see NOTES.md).
+  # Interleaved pipeline (reference config pipeline.num_stages_per_device):
+  # blocks split into K chained passes, so each device holds K
+  # non-adjacent block chunks.  On the vmapped engines this is the
+  # circular WEIGHT DISTRIBUTION only; on the shard_map engine
+  # (pipeline.engine="smap") K > 1 upgrades the schedule to true
+  # Megatron-interleaved 1F1B (parallel/pipeline_interleaved.py) with
+  # the ramp shrunk to 2(S-1) + (K-1)S one-chunk ticks.
   pipeline_interleave: int = 1
   # Explicit per-chunk block counts (len == stages*interleave), e.g. from
   # the auto-parallel planner; overrides the default even/ceil layout.
@@ -568,17 +570,17 @@ def make_gpt_1f1b_grad_fn(model: GPT):
   if cfg.pipeline_stages <= 1:
     raise ValueError("1F1B needs pipeline_stages > 1")
   if cfg.pipeline_interleave > 1:
-    # Deliberately unsupported, not a TODO: in this engine's lockstep
-    # SPMD wavefront every tick costs a full device-share of compute
-    # (masked chunks execute anyway), so a K-way chunk-interleaved chain
-    # has ramp 2(S*K-1) chunk-ticks ~= 2(S - 1/K) device-ticks — never
-    # better than plain 1F1B's 2(S-1).  Megatron's interleave win needs
-    # per-rank asynchronous schedules the uniform-program formulation
-    # cannot express.  See strategies/scheduler.py.
+    # Deliberately unsupported ON THIS ENGINE: in the lockstep SPMD
+    # wavefront every tick costs a full device-share of compute (masked
+    # chunks execute anyway), so a K-way chunk-interleaved chain has
+    # ramp 2(S*K-1) chunk-ticks ~= 2(S - 1/K) device-ticks — never
+    # better than plain 1F1B's 2(S-1).  The per-rank smap engine CAN
+    # express the Megatron win (see strategies/scheduler.py).
     raise ValueError(
-        "1F1B with pipeline_interleave > 1 is not supported: chunk "
-        "interleaving cannot beat plain 1F1B under this engine's "
-        "lockstep SPMD schedule (see strategies/scheduler.py); use "
+        "1F1B with pipeline_interleave > 1 is not supported on the "
+        "lockstep vmapped engine (chunk interleaving cannot beat plain "
+        "1F1B here — see strategies/scheduler.py); use "
+        "pipeline.engine='smap' for true Megatron-interleaved 1F1B, "
         "interleave=1, or PreferForward for circular weight placement")
   S, M = cfg.pipeline_stages, cfg.num_micro_batch
   blocks_per_stage, n_active = stage_layout(cfg.num_layers, S,

@@ -25,21 +25,16 @@ Here the policies select between two genuinely different programs:
 ``remat_stage`` is also consulted by forward-only Pipeline module uses
 (eval), where it toggles per-stage checkpointing.
 
-Why there is no Megatron-style interleaved (virtual-stage) 1F1B: the
-1F1B engine is a lockstep SPMD wavefront — one uniform program per tick
-across all S stages, with validity masking.  A masked chunk costs the
-same as a live one, so splitting each device's layers into K virtual
-chunks makes the pipeline chain S*K chunk-stages deep and the ramp
-2(S*K-1) chunk-ticks = 2(S - 1/K) device-ticks of work — marginally
-WORSE than plain 1F1B's 2(S-1), for K times the schedule complexity.
-Megatron's (S-1)/K bubble reduction comes from per-rank asynchronous
-schedules (each rank runs a different chunk sequence against
-point-to-point sends), which a uniform-program formulation cannot
-express without paying the masked ticks.  Within the lockstep design
-K=1 is optimal; ``pipeline_interleave > 1`` therefore remains what the
-reference's ``pipeline.num_stages_per_device`` is — a circular WEIGHT
-PLACEMENT across devices (models/gpt.py) — and requesting it together
-with 1F1B raises.
+Megatron-style interleaved (virtual-stage) 1F1B: impossible on the
+LOCKSTEP engines (a masked chunk costs the same as a live one, so K-way
+chunk interleaving has ramp 2(S - 1/K) device-ticks — never better than
+plain 1F1B's 2(S-1); requesting it with 1F1B on the vmapped engines
+falls back with a warning, and interleave stays the reference's
+circular weight placement there).  The per-rank formulation CAN express
+it: ``pipeline.engine="smap"`` with ``pipeline_interleave=K > 1``
+dispatches the table-driven interleaved engine
+(parallel/pipeline_interleaved.py) whose real branches shrink the ramp
+to 2(S-1) + (K-1)S one-chunk ticks — see BASELINE.md round 4.
 """
 
 from __future__ import annotations
