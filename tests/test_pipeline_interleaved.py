@@ -136,3 +136,42 @@ def test_interleaved_gpipe_order_raises():
                   pipeline_interleave=2)
   with pytest.raises(ValueError, match="interleave"):
     make_gpt_smap_grad_fn(GPT(cfg), mesh, schedule="gpipe")
+
+
+@pytest.mark.parametrize("S,K,M", [(2, 2, 4), (4, 2, 8), (4, 4, 8),
+                                   (3, 2, 6), (2, 3, 6), (8, 2, 8)])
+def test_schedule_buffer_replay_no_collisions(S, K, M):
+  """Replays the engine's exact buffer usage against the tick tables:
+  every InBuf/Res/CotBuf read must see the value written for that
+  (chunk, micro-batch), and no slot may be overwritten while its value
+  is still pending — the mb % W slot keying is only collision-free
+  while the in-flight window stays under W."""
+  sch = build_interleaved_schedule(S, K, M)
+  W = sch.W
+  for d in range(S):
+    inbuf = {}   # (chunk, slot) -> mb whose activation is stored
+    res = {}
+    cot = {}
+    for t in range(sch.T):
+      # receives (start of tick)
+      if sch.rf_valid[t, d]:
+        inbuf[(int(sch.rf_chunk[t, d]), int(sch.rf_slot[t, d]))] = \
+            int(sch.f_mb[t - 1, (d - 1) % S])
+      if sch.rb_valid[t, d]:
+        cot[(int(sch.rb_chunk[t, d]), int(sch.rb_slot[t, d]))] = \
+            int(sch.b_mb[t - 1, (d + 1) % S])
+      # forward sub-tick: read input, write residual
+      if sch.f_valid[t, d]:
+        j, m = int(sch.f_chunk[t, d]), int(sch.f_mb[t, d])
+        if not (j == 0 and d == 0):            # non-feed input from ring
+          got = inbuf.get((j, m % W))
+          assert got == m, (d, t, j, m, got)
+        res[(j, m % W)] = m
+      # emit writes the final-chunk cotangent on device S-1
+      if sch.emit_valid[t] and d == S - 1:
+        cot[(K - 1, int(sch.emit_mb[t]) % W)] = int(sch.emit_mb[t])
+      # backward sub-tick: read cotangent + residual
+      if sch.b_valid[t, d]:
+        j, m = int(sch.b_chunk[t, d]), int(sch.b_mb[t, d])
+        assert cot.get((j, m % W)) == m, (d, t, j, m)
+        assert res.get((j, m % W)) == m, (d, t, j, m)
