@@ -739,6 +739,19 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
         "pipeline_interleave > 1 on the smap engine requires the "
         "interleaved-1F1B schedule (pipeline.strategy PreferBackward*); "
         "GPipe order does not interleave chunks")
+  seq_size = 1
+  try:
+    seq_size = Env.get().cluster.axis_size(constants.SEQ_AXIS)
+  except Exception:
+    pass
+  if cfg.attn_impl in ("ring", "ulysses") and seq_size > 1:
+    raise ValueError(
+        f"attn_impl={cfg.attn_impl!r} (sequence parallelism) composes "
+        "with the vmapped pipeline engines only: on the smap engine its "
+        "seq-axis collectives would run inside the real lax.cond "
+        "branches and deadlock when stage groups branch differently "
+        "(ramp ticks).  Use pipeline.engine='' for pipeline x sequence "
+        "hybrids, or 'pallas_flash'/'xla' attention on the smap engine.")
   if cfg.num_experts > 0:
     if cfg.moe_impl == "a2a":
       raise ValueError(

@@ -533,6 +533,24 @@ def _ring_flash(q, k, v, causal: bool):
       out = _ring_local(n, causal, qt, kt, vt)
     return out.transpose(0, 2, 1, 3)
 
+  # Inside another manual region (the smap pipeline engine's stage
+  # program) the ring is NOT safe: nesting compiles (abstract-mesh
+  # shard_map over the seq axis works), but the ring's ppermutes would
+  # then execute inside the engine's real `lax.cond` branches, and stage
+  # groups take different branches at ramp ticks — half the devices
+  # never reach the shared-channel collective and the program deadlocks
+  # (observed as an XLA rendezvous termination).  Fail loudly instead.
+  from easyparallellibrary_tpu.utils.sharding import manual_axes
+  outer_manual = manual_axes()
+  if outer_manual:
+    raise ValueError(
+        "ring attention cannot run inside a manual shard_map region "
+        f"(manual axes {sorted(outer_manual)}): its seq-axis collectives "
+        "would be gated by the region's branches and deadlock.  Use the "
+        "vmapped pipeline engines (pipeline.engine='' ) with "
+        "sequence parallelism, or attn_impl='pallas_flash'/'xla' on the "
+        "smap engine.")
+
   # Batch on data, sequence on seq, heads on model (survives TP head
   # sharding); stage/expert axes replicated.
   from easyparallellibrary_tpu.sequence._util import axis_if_divisible

@@ -66,6 +66,16 @@ def _ulysses_flash(q, k, v, causal: bool):
   def local(q_l, k_l, v_l):
     return flash_attention(q_l, k_l, v_l, causal=causal)
 
+  from easyparallellibrary_tpu.utils.sharding import manual_axes
+  outer_manual = manual_axes()
+  if outer_manual:
+    # Same hazard as ring attention: the head<->seq all-to-alls would be
+    # gated by the enclosing region's branches and deadlock.
+    raise ValueError(
+        "ulysses attention cannot run inside a manual shard_map region "
+        f"(manual axes {sorted(outer_manual)}): its seq-axis all-to-alls "
+        "would be gated by the region's branches and deadlock; use the "
+        "vmapped pipeline engines for pipeline x sequence hybrids.")
   out = jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
                       out_specs=spec, check_vma=False)(q, k, v)
   return _constrain(out, SEQ_SHARDED)

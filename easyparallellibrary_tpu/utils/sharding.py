@@ -23,6 +23,15 @@ from easyparallellibrary_tpu.utils.logging import get_logger
 
 UNCONSTRAINED = P.UNCONSTRAINED
 
+
+def manual_axes() -> frozenset:
+  """Mesh axes that are Manual in the ambient shard_map region (empty
+  outside one).  The single compatibility shim for the abstract-mesh
+  API — consult this, not jax.sharding directly."""
+  return frozenset(
+      getattr(jax.sharding.get_abstract_mesh(), "manual_axes", ()) or ())
+
+
 _warned_sites = set()
 
 
@@ -40,8 +49,7 @@ def constrain(x, spec: P):
   # is an error at lowering time (too late for the except below).  Strip
   # manual axes from the spec — per-shard values are already placed on
   # them — and keep any non-manual remainder (partial-manual shard_map).
-  manual = frozenset(
-      getattr(jax.sharding.get_abstract_mesh(), "manual_axes", ()) or ())
+  manual = manual_axes()
   if manual:
     def clean(entry):
       if entry is None or entry is P.UNCONSTRAINED:

@@ -406,3 +406,70 @@ def test_smap_moe_a2a_impl_raises():
                   num_experts=2, moe_impl="a2a")
   with pytest.raises(ValueError, match="a2a"):
     make_gpt_smap_grad_fn(GPT(cfg), mesh)
+
+
+def test_smap_zero_v0_trains():
+  """ZeRO-v0 (GSPMD optimizer-state sharding) composes with the
+  config-dispatched smap engine — it is a state-layout decision,
+  engine-independent."""
+  import optax
+  from easyparallellibrary_tpu.models.gpt import make_gpt_train_step
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state, parallelize)
+
+  env = epl.init(epl.Config({"pipeline.engine": "smap",
+                             "zero.level": "v0"}))
+  cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=2, d_model=16,
+                  d_ff=32, max_seq_len=8, dtype=jnp.float32,
+                  pipeline_stages=2, num_micro_batch=4)
+  with epl.replicate(1):
+    model = GPT(cfg)
+  mesh = env.cluster.build_mesh(stage=2)
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (16, 9)),
+                    jnp.int32)
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(rng, ids[:, :-1])["params"],
+                             tx=optax.adamw(1e-2))
+
+  state, sh = create_sharded_train_state(init_fn, mesh,
+                                         jax.random.PRNGKey(0))
+  step = parallelize(make_gpt_train_step(model), mesh, sh)
+  losses = []
+  for i in range(3):
+    state, m = step(state, {"ids": ids}, jax.random.PRNGKey(i))
+    losses.append(float(m["loss"]))
+  assert all(np.isfinite(l) for l in losses) and losses[-1] < losses[0]
+
+
+def test_smap_sequence_parallel_raises():
+  """Ring/Ulysses attention on the smap engine would run seq-axis
+  collectives inside the engine's real branches and deadlock (observed
+  as an XLA rendezvous termination) — both the engine builder and the
+  ring itself refuse with named errors."""
+  env = epl.init(epl.Config({"sequence.parallelism": "ring",
+                             "sequence.axis_size": 2}))
+  mesh = env.cluster.build_mesh(stage=2, seq=2)
+  cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=2, d_model=16,
+                  d_ff=32, max_seq_len=16, dtype=jnp.float32,
+                  pipeline_stages=2, num_micro_batch=2,
+                  seq_parallel=True, attn_impl="ring")
+  with pytest.raises(ValueError, match="vmapped"):
+    make_gpt_smap_grad_fn(GPT(cfg), mesh)
+
+  # The ring itself also refuses inside any manual region.
+  from easyparallellibrary_tpu.sequence import ring_attention
+  from jax.sharding import PartitionSpec as P
+
+  def body(q, k, v):
+    return ring_attention(q, k, v, causal=True)
+
+  q = jnp.ones((2, 16, 2, 8), jnp.float32)
+  mapped = jax.shard_map(body, mesh=mesh,
+                         in_specs=(P("stage"),) * 3,
+                         out_specs=P("stage"),
+                         axis_names=frozenset({"stage"}),
+                         check_vma=False)
+  with pytest.raises(ValueError, match="manual"):
+    jax.jit(mapped)(q, q, q)
