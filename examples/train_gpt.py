@@ -8,6 +8,9 @@ Usage (single host; add `epl-tpu-launch` for multi-host):
   python examples/train_gpt.py --tp 2 --pp 2 --micro 4 --zero v1
   python examples/train_gpt.py --experts 8           # GPT-MoE
   python examples/train_gpt.py --seq ring --seq-size 4   # ring attention
+  python examples/train_gpt.py --pp 2 --micro 8 --engine smap
+  python examples/train_gpt.py --pp 2 --micro 8 --engine smap \
+      --interleave 2 --layers 8                      # interleaved 1F1B
 
 (reference analog: the FastNN GPT recipes driven by epl.replicate/split,
 /root/reference/README.md:40-70)
@@ -23,7 +26,7 @@ import optax
 import easyparallellibrary_tpu as epl
 from easyparallellibrary_tpu.models import GPT, GPTConfig
 from easyparallellibrary_tpu.models.gpt import (
-    gpt_flops_per_token, gpt_loss)
+    gpt_flops_per_token, gpt_loss, make_gpt_train_step)
 from easyparallellibrary_tpu.parallel import (
     TrainState, create_sharded_train_state, make_train_step, parallelize)
 from easyparallellibrary_tpu.profiler import StepProfiler
@@ -40,6 +43,11 @@ def main():
   p.add_argument("--experts", type=int, default=0)
   p.add_argument("--seq", default="", choices=["", "ring", "ulysses"])
   p.add_argument("--seq-size", type=int, default=1)
+  p.add_argument("--engine", default="", choices=["", "vmap", "smap"],
+                 help="pipeline engine (smap = per-device shard_map "
+                      "programs; with --interleave K > 1 the schedule "
+                      "becomes Megatron-interleaved 1F1B)")
+  p.add_argument("--interleave", type=int, default=1)
   p.add_argument("--layers", type=int, default=4)
   p.add_argument("--d-model", type=int, default=256)
   p.add_argument("--batch", type=int, default=16)
@@ -50,6 +58,7 @@ def main():
   init_distributed()  # no-op single-process
   env = epl.init(epl.Config({
       "pipeline.num_micro_batch": args.micro,
+      "pipeline.engine": args.engine,
       "zero.level": args.zero,
       "sequence.parallelism": args.seq,
       "sequence.axis_size": args.seq_size,
@@ -62,6 +71,7 @@ def main():
       else jnp.float32,
       tensor_parallel=args.tp > 1,
       pipeline_stages=args.pp, num_micro_batch=args.micro,
+      pipeline_interleave=args.interleave,
       num_experts=args.experts,
       seq_parallel=bool(args.seq),
       attn_impl=args.seq or "xla",
@@ -94,9 +104,10 @@ def main():
 
   state, shardings = create_sharded_train_state(
       init_fn, mesh, jax.random.PRNGKey(0), zero_level=args.zero)
-  step = parallelize(
-      make_train_step(lambda p, b, r: gpt_loss(model, p, b, r)),
-      mesh, shardings)
+  # make_gpt_train_step dispatches on the Config: pipeline engine
+  # (vmap/smap), schedule policy, grouped apply, AMP — the analog of the
+  # reference rewriting the session graph from its Config.
+  step = parallelize(make_gpt_train_step(model), mesh, shardings)
 
   tokens_per_step = args.batch * cfg.max_seq_len
   prof = StepProfiler(
