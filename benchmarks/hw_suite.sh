@@ -47,4 +47,8 @@ if [ -f benchmarks/smap_overhead.py ]; then
   run 1800 HW/smap_overhead.json python benchmarks/smap_overhead.py
 fi
 
+echo "--- MFU tuning sweep (VERDICT item 7: toward 0.55) ---"
+timeout 3600 bash benchmarks/mfu_sweep.sh > HW/mfu_sweep.txt 2>&1
+echo "[$(date -u +%FT%TZ)] mfu_sweep rc=$? (HW/mfu_sweep.txt)"
+
 echo "=== hw_suite done $(date -u +%FT%TZ) ==="
