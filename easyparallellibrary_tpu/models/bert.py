@@ -233,3 +233,156 @@ def bert_mlm_loss(model: Bert, params, batch, rng=None):
   mask = batch["mask"].astype(jnp.float32)
   total = jnp.sum(loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
   return total, {}
+
+
+def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
+  """Per-device shard_map pipeline gradient function for BERT.
+
+  The GPT smap wiring (models/gpt.py:make_gpt_smap_grad_fn) applied to
+  the encoder family — proof the engines are framework infrastructure,
+  not a GPT special case (BASELINE row 2 is the reference's pipeline
+  tutorial model, /root/reference/docs/en/tutorials/pipe.md:33-48):
+
+    feed  = stage-vocab-sharded token lookup (psum) + position/segment
+            embeddings + embedding LayerNorm,
+    stage = L/S EncoderBlocks per device (non-causal attention; TP
+            composes through the auto model axis),
+    emit  = final LayerNorm + tied-table MLM logits slab + sharded CE,
+            normalized by THIS micro-batch's mask count.
+
+  Per-micro-batch loss semantics: the engine averages the M per-mb
+  masked means, which equals `bert_mlm_loss`'s global ratio only when
+  mask counts are equal across micro-batches (the standard fixed-count
+  MLM masking); with ragged counts the two differ by the usual
+  mean-of-ratios vs ratio-of-sums gap.
+
+  Constraints (each raises): pipeline_stages > 1,
+  vocab_size % pipeline_stages == 0, unpadded vocab under TP.
+  """
+  from easyparallellibrary_tpu.env import Env
+  from easyparallellibrary_tpu.parallel.pipeline_smap import (
+      MANUAL_AXES, check_unpadded_vocab, make_smap_1f1b_grad_fn,
+      make_smap_gpipe_grad_fn, rebox_grads, run_smap_engine,
+      sharded_softmax_ce, stage_stacked_specs, vocab_partial_embed)
+  from easyparallellibrary_tpu.parallel.schedule_1f1b import (
+      split_micro_batches)
+  from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
+
+  cfg = resolve_model_dtypes(model.cfg)
+  S, M = cfg.pipeline_stages, cfg.num_micro_batch
+  if S <= 1:
+    raise ValueError("smap pipeline needs pipeline_stages > 1")
+  if cfg.vocab_size % S:
+    raise ValueError(f"vocab_size {cfg.vocab_size} must divide into "
+                     f"{S} stage-resident shards")
+  if cfg.num_layers % S:
+    raise ValueError("num_layers must divide pipeline_stages (the "
+                     "model's own constraint)")
+  if schedule not in ("gpipe", "1f1b"):
+    raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
+  blocks_per_stage = cfg.num_layers // S
+  if mesh is None:
+    mesh = Env.get().cluster.mesh
+  if cfg.tensor_parallel:
+    check_unpadded_vocab(cfg.vocab_size, mesh)
+
+  ln_emb = LayerNorm(dtype=cfg.dtype)
+  ln_f = LayerNorm(dtype=cfg.dtype)
+
+  def feed_fn(p, mb, rng):
+    ids = mb["ids"]
+    type_ids = mb.get("type_ids", jnp.zeros_like(ids))
+    x = jax.lax.psum(vocab_partial_embed(p["wte"]["embedding"], ids),
+                     constants.STAGE_AXIS).astype(cfg.dtype)
+    x = x + p["wpe"][None, :ids.shape[1]].astype(cfg.dtype)
+    x = x + jnp.take(p["wse"]["embedding"], type_ids,
+                     axis=0).astype(cfg.dtype)
+    return ln_emb.apply({"params": p["ln_emb"]}, x)
+
+  def stage_fn(p, x, rng, chunk=None):
+    row = p["pipeline"]["stages"]["stacked"]
+    for i in range(blocks_per_stage):
+      bp = jax.tree_util.tree_map(lambda l: l[0], row[f"block_{i}"])
+      blk = EncoderBlock(cfg)
+
+      def apply_blk(xx, bp=bp, blk=blk):
+        return blk.apply({"params": bp}, xx)
+
+      if cfg.remat:
+        apply_blk = jax.checkpoint(apply_blk, prevent_cse=False)
+      x = apply_blk(x)
+    return x, jnp.float32(0)
+
+  def emit_fn(p, y, mb, valid, rng):
+    h = ln_f.apply({"params": p["ln_f"]}, y)
+    w = p["wte"]["embedding"]                      # [V/S, D] local slice
+
+    def slab(hh):
+      return jnp.matmul(hh, w.T.astype(hh.dtype))
+
+    ll = jax.lax.cond(
+        valid, jax.checkpoint(slab),
+        lambda hh: jnp.zeros(hh.shape[:-1] + (w.shape[0],), hh.dtype), h)
+    ce = sharded_softmax_ce(ll, mb["labels"])
+    mask = mb["mask"].astype(jnp.float32)
+    return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+  engine_cache = {}
+
+  def grad_fn(params, batch, rng, loss_scale=None):
+    un = nn.meta.unbox(params)
+    if "fn" not in engine_cache:
+      specs = stage_stacked_specs(un)
+      specs["wte"]["embedding"] = P(constants.STAGE_AXIS, None)
+      build = (make_smap_1f1b_grad_fn if schedule == "1f1b"
+               else make_smap_gpipe_grad_fn)
+      engine_cache["fn"] = build(
+          feed_fn, stage_fn, emit_fn, S, M, mesh, specs,
+          manual_axes=MANUAL_AXES)
+    mbs = split_micro_batches(
+        {k: v for k, v in batch.items()
+         if k in ("ids", "labels", "mask", "type_ids")}, M)
+    (loss, metrics), g = run_smap_engine(
+        engine_cache["fn"], schedule, un, mbs, rng, loss_scale)
+    metrics = {k: v for k, v in dict(metrics).items()
+               if k != "stage_aux_loss"}
+    return (loss, metrics), rebox_grads(params, g)
+
+  return grad_fn
+
+
+def make_bert_train_step(model: Bert, config=None):
+  """Config-driven train step for BERT, engine-aware (the BERT analog of
+  models/gpt.py:make_gpt_train_step): ``pipeline.engine="smap"`` with
+  pipeline stages dispatches the shard_map engine (schedule policy picks
+  gpipe/1f1b order); everything else uses the standard autodiff path
+  over :func:`bert_mlm_loss`."""
+  from easyparallellibrary_tpu.env import Env
+  from easyparallellibrary_tpu.runtime.trainer import build_train_step
+  from easyparallellibrary_tpu.strategies.scheduler import get_scheduler
+
+  cfg = model.cfg
+  conf = config if config is not None else Env.get().config
+  if cfg.pipeline_stages > 1 and not cfg.pipeline_debug_sequential:
+    sched = get_scheduler(cfg.pipeline_schedule or conf.pipeline.strategy)
+    if conf.pipeline.engine == "smap":
+      groups = None
+      if sched.grouped_apply and conf.optimizer.num_apply_group <= 1:
+        groups = cfg.pipeline_stages
+      schedule = "1f1b" if sched.remat_stage else "gpipe"
+      return build_train_step(
+          grad_fn=make_bert_smap_grad_fn(model, schedule=schedule),
+          config=conf, num_apply_group=groups)
+    if sched.remat_stage:
+      # Unlike GPT, BERT has no vmapped 1F1B grad_fn: without the smap
+      # engine, PreferBackward* falls back to GPipe-order autodiff (M
+      # live activations per stage).  Say so instead of silently
+      # mislabeling memory behavior.
+      from easyparallellibrary_tpu.utils.logging import get_logger
+      get_logger().warning(
+          "pipeline.strategy=%s on BERT runs as GPipe-order autodiff "
+          "unless pipeline.engine='smap' (no vmapped 1F1B wiring for "
+          "BERT); set pipeline.engine='smap' for true 1F1B order.",
+          sched.name)
+  return build_train_step(lambda p, b, r: bert_mlm_loss(model, p, b, r),
+                          config=conf)

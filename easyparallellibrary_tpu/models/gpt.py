@@ -718,8 +718,9 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
   """
   from easyparallellibrary_tpu.env import Env
   from easyparallellibrary_tpu.parallel.pipeline_smap import (
-      make_smap_1f1b_grad_fn, make_smap_gpipe_grad_fn, sharded_softmax_ce,
-      vocab_partial_embed)
+      MANUAL_AXES, check_unpadded_vocab, make_smap_1f1b_grad_fn,
+      make_smap_gpipe_grad_fn, rebox_grads, run_smap_engine,
+      sharded_softmax_ce, stage_stacked_specs, vocab_partial_embed)
   from easyparallellibrary_tpu.parallel.schedule_1f1b import (
       split_micro_batches)
   from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
@@ -776,14 +777,7 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
   if mesh is None:
     mesh = Env.get().cluster.mesh
   if cfg.tensor_parallel:
-    model_size = dict(zip(mesh.axis_names,
-                          mesh.devices.shape)).get(constants.MODEL_AXIS, 1)
-    if cfg.vocab_size % max(model_size, 1):
-      raise ValueError(
-          f"smap engine with tensor_parallel needs an unpadded vocab "
-          f"table: vocab_size {cfg.vocab_size} must divide the model "
-          f"axis ({model_size}) — padded vocab rows would corrupt the "
-          f"stage-resident CE normalizer")
+    check_unpadded_vocab(cfg.vocab_size, mesh)
 
   ln_f = LayerNorm(dtype=cfg.dtype)
   policy = _remat_policy(cfg.remat_policy)
@@ -904,14 +898,11 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
       # Manual (stage/data) projection only: model-axis TP shardings ride
       # the argument arrays through the auto axes (partial-manual
       # shard_map — see pipeline_smap module docstring).
-      specs = jax.tree_util.tree_map(lambda _: P(), un)
+      specs = stage_stacked_specs(un)
       specs["wte"]["embedding"] = P(constants.STAGE_AXIS, None)
       if not cfg.tie_embeddings:
         specs["lm_head"]["kernel"] = P(None, constants.STAGE_AXIS)
-      specs["pipeline"]["stages"]["stacked"] = jax.tree_util.tree_map(
-          lambda _: P(constants.STAGE_AXIS),
-          un["pipeline"]["stages"]["stacked"])
-      manual = frozenset({constants.STAGE_AXIS, constants.DATA_AXIS})
+      manual = MANUAL_AXES
       aux_w = cfg.moe_aux_weight if cfg.num_experts > 0 else 0.0
       if schedule == "interleaved":
         from easyparallellibrary_tpu.parallel.pipeline_interleaved import (
@@ -928,19 +919,9 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
     ids = batch["ids"]
     mbs = split_micro_batches(
         {"inputs": ids[:, :-1], "targets": ids[:, 1:]}, M)
-    if schedule in ("1f1b", "interleaved"):
-      (loss, metrics), g = engine_cache["fn"](un, mbs, rng, loss_scale)
-    else:
-      if loss_scale is not None:
-        raise ValueError("loss_scale seeding needs schedule='1f1b' "
-                         "(the gpipe path is plain autodiff)")
-      (loss, metrics), g = engine_cache["fn"](un, mbs, rng)
-    g = from_engine_grads(g)
-    grads = jax.tree_util.tree_map(
-        lambda box, gg: box.replace_boxed(gg)
-        if isinstance(box, nn.meta.AxisMetadata) else gg,
-        params, g,
-        is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata))
+    (loss, metrics), g = run_smap_engine(
+        engine_cache["fn"], schedule, un, mbs, rng, loss_scale)
+    grads = rebox_grads(params, from_engine_grads(g))
     metrics = dict(metrics)
     aux_metric = metrics.pop("stage_aux_loss", None)
     if cfg.num_experts > 0 and aux_metric is not None:

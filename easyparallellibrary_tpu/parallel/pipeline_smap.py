@@ -149,6 +149,62 @@ def _stage_psum_specs(param_specs):
       needs, param_specs, is_leaf=lambda x: isinstance(x, P))
 
 
+# ------------------------------------------------ model-wiring helpers --
+#
+# Shared by the GPT and BERT smap wirings (and any future model family)
+# so the spec construction, dispatch, and grad re-boxing cannot drift
+# between them.
+
+def stage_stacked_specs(un):
+  """Manual (stage/data-projection) specs for a params tree whose
+  pipeline trunk lives at ["pipeline"]["stages"]["stacked"]: everything
+  replicated except the stacked leaves, stage-split on dim 0.  Callers
+  overlay boundary-layer entries (vocab-sharded tables etc.)."""
+  specs = jax.tree_util.tree_map(lambda _: P(), un)
+  specs["pipeline"]["stages"]["stacked"] = jax.tree_util.tree_map(
+      lambda _: P(constants.STAGE_AXIS),
+      un["pipeline"]["stages"]["stacked"])
+  return specs
+
+
+def check_unpadded_vocab(vocab_size: int, mesh: Mesh) -> None:
+  """TP + stage-resident CE requires an unpadded vocab table: padded
+  rows would corrupt the collectively-computed normalizer."""
+  model_size = dict(zip(mesh.axis_names,
+                        mesh.devices.shape)).get(constants.MODEL_AXIS, 1)
+  if vocab_size % max(model_size, 1):
+    raise ValueError(
+        f"smap engine with tensor_parallel needs an unpadded vocab "
+        f"table: vocab_size {vocab_size} must divide the model axis "
+        f"({model_size}) — padded vocab rows would corrupt the "
+        f"stage-resident CE normalizer")
+
+
+def run_smap_engine(fn, schedule: str, un, mbs, rng, loss_scale):
+  """Dispatch with the engines' loss_scale contract: the manual-VJP
+  schedules accept the AMP seed; the gpipe autodiff path rejects it."""
+  if schedule in ("1f1b", "interleaved"):
+    return fn(un, mbs, rng, loss_scale)
+  if loss_scale is not None:
+    raise ValueError("loss_scale seeding needs schedule='1f1b' "
+                     "(the gpipe path is plain autodiff)")
+  return fn(un, mbs, rng)
+
+
+def rebox_grads(params, g):
+  """Re-box a raw grads tree against the (boxed) params template so it
+  drops into a TrainState."""
+  import flax.linen as nn
+  return jax.tree_util.tree_map(
+      lambda box, gg: box.replace_boxed(gg)
+      if isinstance(box, nn.meta.AxisMetadata) else gg,
+      params, g,
+      is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata))
+
+
+MANUAL_AXES = frozenset({constants.STAGE_AXIS, constants.DATA_AXIS})
+
+
 # ------------------------------------------------------------------- engine
 
 def make_smap_gpipe_grad_fn(feed_fn: Callable,
