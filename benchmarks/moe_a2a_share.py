@@ -18,7 +18,7 @@ cost analysis.  The time share then follows from the chip model
 
 reported for TPU v5e defaults (peak 197 bf16 TFLOP/s, 45 GB/s effective
 per-chip a2a bandwidth, 0.4 MFU) — swap via env vars EPL_A2A_BW_GBS /
-EPL_A2A_MFU.  When the relay yields real multi-chip hardware, replace
+EPL_A2A_MFU / EPL_A2A_PEAK_TFLOPS.  When the relay yields real multi-chip hardware, replace
 this with a profiler trace (the reference gets it implicitly from its
 comm kernels' profiler visibility).
 
@@ -44,6 +44,7 @@ import numpy as np  # noqa: E402
 import optax  # noqa: E402
 
 import easyparallellibrary_tpu as epl  # noqa: E402
+from easyparallellibrary_tpu.profiler import flops as flops_mod  # noqa: E402
 from easyparallellibrary_tpu.models import GPT, GPTConfig  # noqa: E402
 from easyparallellibrary_tpu.models.gpt import gpt_loss  # noqa: E402
 from easyparallellibrary_tpu.parallel import (  # noqa: E402
@@ -109,7 +110,9 @@ def main():
 
   bw = float(os.environ.get("EPL_A2A_BW_GBS", "45")) * 1e9
   mfu = float(os.environ.get("EPL_A2A_MFU", "0.4"))
-  peak = 197e12
+  peak = float(os.environ.get(
+      "EPL_A2A_PEAK_TFLOPS",
+      flops_mod.PEAK_FLOPS["TPU v5e"] / 1e12)) * 1e12
   # Per-chip quantities: HLO is the per-device SPMD program, so its
   # all-to-all shapes and cost flops are already per-chip.
   t_a2a = a2a_bytes / bw

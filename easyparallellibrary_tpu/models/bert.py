@@ -139,7 +139,7 @@ class Bert(nn.Module):
       from easyparallellibrary_tpu.parallel.pipeline import Pipeline
       from easyparallellibrary_tpu.strategies.scheduler import get_scheduler
       if cfg.num_layers % cfg.pipeline_stages != 0:
-        raise ValueError("num_layers must divide pipeline_stages")
+        raise ValueError("num_layers must be divisible by pipeline_stages")
       from easyparallellibrary_tpu.env import Env
       sched = get_scheduler(cfg.pipeline_schedule
                             or Env.get().config.pipeline.strategy)
@@ -276,8 +276,8 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
     raise ValueError(f"vocab_size {cfg.vocab_size} must divide into "
                      f"{S} stage-resident shards")
   if cfg.num_layers % S:
-    raise ValueError("num_layers must divide pipeline_stages (the "
-                     "model's own constraint)")
+    raise ValueError("num_layers must be divisible by pipeline_stages "
+                     "(the model's own constraint)")
   if schedule not in ("gpipe", "1f1b"):
     raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
   blocks_per_stage = cfg.num_layers // S

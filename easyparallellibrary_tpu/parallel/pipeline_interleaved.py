@@ -369,7 +369,7 @@ def make_smap_interleaved_grad_fn(feed_fn: Callable,
       feed_rng = (None if rng is None
                   else jax.random.fold_in(rng, (S * K) * M + fm))
       x_fed = feed_fn(params, mb_at(fm), feed_rng)
-      is_feed = (jf == 0) & (s_idx == 0)
+      is_feed = vf & (jf == 0) & (s_idx == 0)
       x_in = jnp.where(is_feed, x_fed,
                        buf_read(InBuf, jf, jnp.mod(mf, W)))
       Res = buf_write(Res, x_in, jf, jnp.mod(mf, W), vf)
