@@ -99,6 +99,45 @@ def measure(zero_level: str):
   }
 
 
+def measure_smap(zero_level: str):
+  """ZeRO x smap pipeline engine (VERDICT r4 item 5): GPT on a
+  stage2 x data4 mesh through the config-dispatched engine; with
+  zero.level="v1" the engine's grad reduction is the explicit
+  reduce-scatter-to-owner and opt state is owner-sharded."""
+  from easyparallellibrary_tpu.models import GPT, GPTConfig
+  from easyparallellibrary_tpu.models.gpt import make_gpt_train_step
+
+  conf = {"pipeline.engine": "smap"}
+  if zero_level:
+    conf["zero.level"] = zero_level
+  env = epl.init(epl.Config(conf))
+  cfg = GPTConfig(vocab_size=512, num_layers=4, num_heads=8, d_model=256,
+                  d_ff=1024, max_seq_len=64, dtype=jnp.float32,
+                  pipeline_stages=2, num_micro_batch=2)
+  with epl.replicate(1):
+    model = GPT(cfg)
+  mesh = env.cluster.build_mesh(stage=2)
+  ids = jnp.asarray(np.random.RandomState(0).randint(
+      0, cfg.vocab_size, (8, cfg.max_seq_len + 1)), jnp.int32)
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(rng, ids[:, :-1])["params"],
+                             tx=optax.adam(1e-3))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0), zero_level=zero_level)
+  step = parallelize(make_gpt_train_step(model), mesh, shardings)
+  mem = step.jitted.lower(
+      state, {"ids": ids}, jax.random.PRNGKey(1)).compile(
+      ).memory_analysis()
+  return {
+      "argument_bytes": int(mem.argument_size_in_bytes),
+      "temp_bytes": int(mem.temp_size_in_bytes),
+      "output_bytes": int(mem.output_size_in_bytes),
+  }
+
+
 def main():
   out = {}
   for name, level in [("zero_off", ""), ("zero_v0", "v0"),
@@ -107,6 +146,11 @@ def main():
   off = out["zero_off"]["argument_bytes"]
   v1 = out["zero_v1"]["argument_bytes"]
   out["v1_vs_off_argument_ratio"] = round(v1 / off, 4)
+  for name, level in [("smap_zero_off", ""), ("smap_zero_v1", "v1")]:
+    out[name] = measure_smap(level)
+  s_off = out["smap_zero_off"]["argument_bytes"]
+  s_v1 = out["smap_zero_v1"]["argument_bytes"]
+  out["smap_v1_vs_off_argument_ratio"] = round(s_v1 / s_off, 4)
   print(json.dumps(out))
 
 

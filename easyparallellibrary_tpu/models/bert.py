@@ -42,6 +42,11 @@ class BertConfig:
   pipeline_stages: int = 1
   num_micro_batch: int = 1
   pipeline_schedule: str = ""   # "" = from Config pipeline.strategy
+  # Megatron-interleaved virtual chunks per device (K): the K pipeline
+  # passes become pipeline_0..pipeline_{K-1} param trees; the smap
+  # engine upgrades 1f1b to the interleaved schedule (same convention
+  # as GPTConfig.pipeline_interleave).
+  pipeline_interleave: int = 1
   pipeline_debug_sequential: bool = False
 
 
@@ -138,21 +143,29 @@ class Bert(nn.Module):
     if cfg.pipeline_stages > 1:
       from easyparallellibrary_tpu.parallel.pipeline import Pipeline
       from easyparallellibrary_tpu.strategies.scheduler import get_scheduler
-      if cfg.num_layers % cfg.pipeline_stages != 0:
-        raise ValueError("num_layers must be divisible by pipeline_stages")
+      K = max(1, cfg.pipeline_interleave)
+      chunks = cfg.pipeline_stages * K
+      if cfg.num_layers % chunks != 0:
+        raise ValueError(
+            "num_layers must be divisible by pipeline_stages "
+            "* pipeline_interleave")
       from easyparallellibrary_tpu.env import Env
       sched = get_scheduler(cfg.pipeline_schedule
                             or Env.get().config.pipeline.strategy)
-      x = Pipeline(
-          stage_module_cls=BertStage,
-          stage_kwargs=dict(
-              cfg=cfg,
-              blocks_per_stage=cfg.num_layers // cfg.pipeline_stages),
-          num_stages=cfg.pipeline_stages,
-          num_micro_batch=cfg.num_micro_batch,
-          sequential=cfg.pipeline_debug_sequential,
-          remat_stage=sched.remat_stage or cfg.remat,
-          name="pipeline")(x)
+      for k in range(K):
+        # Pass k owns contiguous chunks k*S .. k*S+S-1: stage s holds
+        # chunk k*S+s in pass k — every S-th chunk across the K passes
+        # (the circular weight distribution; same layout as GPT).
+        x = Pipeline(
+            stage_module_cls=BertStage,
+            stage_kwargs=dict(
+                cfg=cfg,
+                blocks_per_stage=cfg.num_layers // chunks),
+            num_stages=cfg.pipeline_stages,
+            num_micro_batch=cfg.num_micro_batch,
+            sequential=cfg.pipeline_debug_sequential,
+            remat_stage=sched.remat_stage or cfg.remat,
+            name="pipeline" if K == 1 else f"pipeline_{k}")(x)
     else:
       block_cls = EncoderBlock
       if cfg.remat:
@@ -256,31 +269,52 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
   MLM masking); with ragged counts the two differ by the usual
   mean-of-ratios vs ratio-of-sums gap.
 
+  ``pipeline_interleave`` K > 1 upgrades ``schedule="1f1b"`` to the
+  Megatron-interleaved table-driven engine, exactly as the GPT wiring
+  does (the K-pass stacking itself is the SHARED
+  ``pipeline_smap.make_engine_tree_fns`` — one helper set, no drift).
+
   Constraints (each raises): pipeline_stages > 1,
-  vocab_size % pipeline_stages == 0, unpadded vocab under TP.
+  vocab_size % pipeline_stages == 0,
+  num_layers % (pipeline_stages * pipeline_interleave) == 0,
+  unpadded vocab under TP, interleave needs the 1F1B-order schedule.
   """
   from easyparallellibrary_tpu.env import Env
   from easyparallellibrary_tpu.parallel.pipeline_smap import (
-      MANUAL_AXES, check_unpadded_vocab, make_smap_1f1b_grad_fn,
+      MANUAL_AXES, check_unpadded_vocab, engine_meta_specs,
+      make_engine_tree_fns, make_smap_1f1b_grad_fn,
       make_smap_gpipe_grad_fn, rebox_grads, run_smap_engine,
-      sharded_softmax_ce, stage_stacked_specs, vocab_partial_embed)
+      sharded_softmax_ce, stage_stacked_specs, vocab_partial_embed,
+      zero1_grad_layout)
   from easyparallellibrary_tpu.parallel.schedule_1f1b import (
       split_micro_batches)
   from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
 
   cfg = resolve_model_dtypes(model.cfg)
   S, M = cfg.pipeline_stages, cfg.num_micro_batch
+  K = max(1, cfg.pipeline_interleave)
   if S <= 1:
     raise ValueError("smap pipeline needs pipeline_stages > 1")
+  if schedule == "1f1b" and K > 1:
+    schedule = "interleaved"
+  if schedule == "interleaved" and K < 2:
+    raise ValueError("schedule='interleaved' needs pipeline_interleave "
+                     ">= 2 (K virtual chunks per device)")
+  if schedule == "gpipe" and K > 1:
+    raise ValueError(
+        "pipeline_interleave > 1 on the smap engine requires the "
+        "interleaved-1F1B schedule (pipeline.strategy PreferBackward*); "
+        "GPipe order does not interleave chunks")
   if cfg.vocab_size % S:
     raise ValueError(f"vocab_size {cfg.vocab_size} must divide into "
                      f"{S} stage-resident shards")
-  if cfg.num_layers % S:
+  if cfg.num_layers % (S * K):
     raise ValueError("num_layers must be divisible by pipeline_stages "
-                     "(the model's own constraint)")
-  if schedule not in ("gpipe", "1f1b"):
-    raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
-  blocks_per_stage = cfg.num_layers // S
+                     "* pipeline_interleave (the model's own constraint)")
+  if schedule not in ("gpipe", "1f1b", "interleaved"):
+    raise ValueError(f"schedule must be gpipe|1f1b|interleaved, "
+                     f"got {schedule!r}")
+  blocks_per_stage = cfg.num_layers // (S * K)
   if mesh is None:
     mesh = Env.get().cluster.mesh
   if cfg.tensor_parallel:
@@ -300,9 +334,19 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
     return ln_emb.apply({"params": p["ln_emb"]}, x)
 
   def stage_fn(p, x, rng, chunk=None):
+    """One stage's blocks.  `chunk` (interleaved only) is the LOCAL
+    chunk index; stacked leaves then arrive [1, K, ...] per device and
+    the chunk's rows are dynamically selected (same convention as the
+    GPT wiring — the dynamic index transposes to the right gradient
+    rows automatically)."""
     row = p["pipeline"]["stages"]["stacked"]
+    if chunk is None:
+      sel = lambda l: l[0]
+    else:
+      sel = lambda l: jax.lax.dynamic_index_in_dim(l[0], chunk, 0,
+                                                   keepdims=False)
     for i in range(blocks_per_stage):
-      bp = jax.tree_util.tree_map(lambda l: l[0], row[f"block_{i}"])
+      bp = jax.tree_util.tree_map(sel, row[f"block_{i}"])
       blk = EncoderBlock(cfg)
 
       def apply_blk(xx, bp=bp, blk=blk):
@@ -328,17 +372,40 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
     return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
   engine_cache = {}
+  # Shared K-pass stacking convention with the GPT wiring.
+  to_engine_tree, from_engine_grads = make_engine_tree_fns(K)
+
+  # ZeRO-1 (config zero.level="v1"): engine grad reduction becomes the
+  # owner reduce-scatter, exactly as in the GPT wiring.
+  zero1_dp = 0
+  if Env.get().config.zero.level == constants.ZERO_V1:
+    zero1_dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+        constants.DATA_AXIS, 1)
+    if zero1_dp <= 1:
+      zero1_dp = 0
 
   def grad_fn(params, batch, rng, loss_scale=None):
-    un = nn.meta.unbox(params)
+    un = to_engine_tree(nn.meta.unbox(params))
     if "fn" not in engine_cache:
       specs = stage_stacked_specs(un)
       specs["wte"]["embedding"] = P(constants.STAGE_AXIS, None)
-      build = (make_smap_1f1b_grad_fn if schedule == "1f1b"
-               else make_smap_gpipe_grad_fn)
-      engine_cache["fn"] = build(
-          feed_fn, stage_fn, emit_fn, S, M, mesh, specs,
-          manual_axes=MANUAL_AXES)
+      zero1 = None
+      if zero1_dp:
+        dims, gspecs = zero1_grad_layout(
+            un, engine_meta_specs(params, K), specs, zero1_dp)
+        zero1 = (dims, gspecs, zero1_dp)
+      if schedule == "interleaved":
+        from easyparallellibrary_tpu.parallel.pipeline_interleaved import (
+            make_smap_interleaved_grad_fn)
+        engine_cache["fn"] = make_smap_interleaved_grad_fn(
+            feed_fn, stage_fn, emit_fn, S, K, M, mesh, specs,
+            manual_axes=MANUAL_AXES, zero1=zero1)
+      else:
+        build = (make_smap_1f1b_grad_fn if schedule == "1f1b"
+                 else make_smap_gpipe_grad_fn)
+        engine_cache["fn"] = build(
+            feed_fn, stage_fn, emit_fn, S, M, mesh, specs,
+            manual_axes=MANUAL_AXES, zero1=zero1)
     mbs = split_micro_batches(
         {k: v for k, v in batch.items()
          if k in ("ids", "labels", "mask", "type_ids")}, M)
@@ -346,7 +413,7 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
         engine_cache["fn"], schedule, un, mbs, rng, loss_scale)
     metrics = {k: v for k, v in dict(metrics).items()
                if k != "stage_aux_loss"}
-    return (loss, metrics), rebox_grads(params, g)
+    return (loss, metrics), rebox_grads(params, from_engine_grads(g))
 
   return grad_fn
 

@@ -712,15 +712,28 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
   ramp from 2(S-1) ticks of K-chunk work to 2(S-1) + (K-1)S ticks of
   one-chunk work (schedule="1f1b" upgrades automatically when K > 1).
 
-  Remaining constraints (each raises): no MoE,
+  Sequence parallelism composes (round 5): ``attn_impl="ring"/"ulysses"``
+  with an active seq axis makes the engine manual over ``seq`` and runs
+  stage compute branch-UNIFORMLY (select, not cond) so the attention's
+  seq collectives execute every tick — XLA gives per-replica-group
+  rendezvous only to all-reduce, so gated collective-permutes /
+  all-to-alls would deadlock.  ``moe_impl="a2a"`` composes the same way
+  (the nested expert shard_map's whole-mesh channels are safe once no
+  device can branch around them).  The real-branch ramp FLOP skip is
+  traded away exactly for these two compositions; everywhere else the
+  engine keeps real branches.
+
+  Remaining constraints (each raises):
   ``vocab_size % pipeline_stages == 0``, interleave needs the 1F1B-order
-  schedule.
+  schedule, ``ring_impl="einsum"`` cannot enter the seq-manual region.
   """
   from easyparallellibrary_tpu.env import Env
   from easyparallellibrary_tpu.parallel.pipeline_smap import (
-      MANUAL_AXES, check_unpadded_vocab, make_smap_1f1b_grad_fn,
+      MANUAL_AXES, check_unpadded_vocab, engine_meta_specs,
+      make_engine_tree_fns, make_smap_1f1b_grad_fn,
       make_smap_gpipe_grad_fn, rebox_grads, run_smap_engine,
-      sharded_softmax_ce, stage_stacked_specs, vocab_partial_embed)
+      sharded_softmax_ce, stage_stacked_specs, vocab_partial_embed,
+      zero1_grad_layout)
   from easyparallellibrary_tpu.parallel.schedule_1f1b import (
       split_micro_batches)
   from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
@@ -745,21 +758,47 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
     seq_size = Env.get().cluster.axis_size(constants.SEQ_AXIS)
   except Exception:
     pass
-  if cfg.attn_impl in ("ring", "ulysses") and seq_size > 1:
-    raise ValueError(
-        f"attn_impl={cfg.attn_impl!r} (sequence parallelism) composes "
-        "with the vmapped pipeline engines only: on the smap engine its "
-        "seq-axis collectives would run inside the real lax.cond "
-        "branches and deadlock when stage groups branch differently "
-        "(ramp ticks).  Use pipeline.engine='' for pipeline x sequence "
-        "hybrids, or 'pallas_flash'/'xla' attention on the smap engine.")
+  seq_manual = cfg.attn_impl in ("ring", "ulysses") and seq_size > 1
+  if seq_manual:
+    # Sequence parallelism composes by making the engine manual over
+    # the seq axis too: the attention's seq collectives (ring ppermutes
+    # / Ulysses all-to-alls) then ride the AMBIENT region — no nested
+    # shard_map, whose lowered channels span all devices (the round-4
+    # deadlock).  Because XLA gives per-replica-group rendezvous only
+    # to all-reduce (collective-permute/all-to-all are single whole-
+    # mesh channels), the engines additionally run stage compute
+    # branch-UNIFORMLY in this mode (pipeline_smap.
+    # uniform_stage_compute): the collectives execute every tick on
+    # every device, restoring the vmapped engines' uniform-work
+    # semantics for exactly this composition.  Tokens shard over seq
+    # like batch elements over data: micro-batches arrive seq-split,
+    # wpe is sliced at the device's global token offset, the emit CE
+    # pmeans its local-token mean over seq, and the engines pmean
+    # grads over seq (pipeline_smap.grad_mean_axes).
+    if cfg.attn_impl == "ring":
+      ring_impl = Env.get().config.sequence.ring_impl
+      if ring_impl not in ("flash", "dense"):
+        raise ValueError(
+            f"sequence.ring_impl={ring_impl!r} cannot run inside the "
+            "smap engine's seq-manual region (the einsum ring is a "
+            "global-array GSPMD program); use ring_impl='flash' or "
+            "'dense', or a vmapped engine (pipeline.engine='')")
+    elif cfg.num_heads % seq_size:
+      raise ValueError(
+          f"Ulysses on the smap engine requires num_heads "
+          f"({cfg.num_heads}) divisible by the seq axis ({seq_size})")
+  a2a_moe = False
   if cfg.num_experts > 0:
     if cfg.moe_impl == "a2a":
-      raise ValueError(
-          "moe_impl='a2a' nests a second shard_map inside the smap "
-          "pipeline engine and is not supported there; use the default "
-          "moe_impl='einsum' (GSPMD handles the expert axis inside the "
-          "stage program) or a vmapped engine")
+      # The a2a MoE's nested shard_map compiles inside the engine's
+      # partial-manual region, and its whole-mesh collective channels
+      # are safe ONLY when no device can skip them: the engine runs
+      # stage compute branch-uniformly for this composition (same
+      # trade as sequence parallelism — uniform_stage_compute).
+      try:
+        a2a_moe = Env.get().cluster.axis_size(constants.EXPERT_AXIS) > 1
+      except Exception:
+        a2a_moe = False
     if cfg.num_layers % (S * K) != 0:
       raise ValueError(
           f"num_layers={cfg.num_layers} must divide evenly into "
@@ -786,8 +825,15 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
     ids = mb["inputs"]
     x = jax.lax.psum(vocab_partial_embed(p["wte"]["embedding"], ids),
                      constants.STAGE_AXIS)
-    return x.astype(cfg.dtype) + \
-        p["wpe"][None, :ids.shape[1]].astype(cfg.dtype)
+    if seq_manual:
+      # ids are this device's token shard; wpe stays replicated and is
+      # sliced at the device's global token offset.
+      t_loc = ids.shape[1]
+      off = jax.lax.axis_index(constants.SEQ_AXIS) * t_loc
+      pe = jax.lax.dynamic_slice_in_dim(p["wpe"], off, t_loc, 0)
+    else:
+      pe = p["wpe"][:ids.shape[1]]
+    return x.astype(cfg.dtype) + pe[None].astype(cfg.dtype)
 
   def stage_fn(p, x, rng, chunk=None):
     """One stage's blocks -> (y, aux_scalar).  `chunk` (interleaved
@@ -832,6 +878,17 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
                                    prevent_cse=False)
       if n_active_arr is None:
         x, a_i = apply_blk(x)
+      elif seq_manual or a2a_moe:
+        # Ring / a2a collectives inside the block: collective-permute
+        # and all-to-all channels span the mesh, so masked slots must
+        # stay branch-uniform (select) — see
+        # pipeline_smap.uniform_stage_compute.  (The a2a arm is
+        # defense-in-depth: GPT.__call__ already rejects MoE with
+        # uneven stage plans.)
+        live = i < n_active_arr[v_idx]
+        x_run, a_run = apply_blk(x)
+        x = jnp.where(live, x_run, x)
+        a_i = jnp.where(live, a_run, 0.0)
       else:
         # Real branch under shard_map: a masked slot costs nothing.
         x, a_i = jax.lax.cond(
@@ -862,37 +919,44 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
         valid, jax.checkpoint(slab),
         lambda hh: jnp.zeros(hh.shape[:-1] + (Vs,), hh.dtype), h)
     loss = sharded_softmax_ce(ll, mb["targets"], z_loss=cfg.z_loss)
-    return jnp.mean(loss)
+    m = jnp.mean(loss)
+    if seq_manual:
+      # Local-token mean -> true micro-batch mean.  Unconditional seq
+      # collective, every tick; seq peers share the engine's predicates
+      # (same stage index) so this is branch-uniform.  Its pmean
+      # transpose also keeps the engines' seed/S calibration exact (the
+      # 1/n cancels the n-peer seeding); only grads need the extra
+      # pmean over seq, applied in the engines' reduction.
+      m = jax.lax.pmean(m, constants.SEQ_AXIS)
+    return m
 
   engine_cache = {}
+  # Shared K-pass stacking convention (pipeline_smap.make_engine_tree_fns
+  # — one helper set with the BERT wiring so the layouts cannot drift).
+  to_engine_tree, from_engine_grads = make_engine_tree_fns(K)
 
-  def to_engine_tree(un):
-    """K=1: identity.  K>1: stack the K pipeline passes on axis 1 of
-    each stacked leaf ([S, K, ...] globally — dim 0 stays the stage
-    split), under the same 'pipeline' path the K=1 tree uses.  Pass k
-    row d is virtual stage k*S + d, so the contiguous stage split
-    already realizes Megatron's circular placement — no permutation."""
-    if K == 1:
-      return un
-    passes = [un[f"pipeline_{k}"]["stages"]["stacked"] for k in range(K)]
-    combined = jax.tree_util.tree_map(
-        lambda *ls: jnp.stack(ls, axis=1), *passes)
-    eng = {key: v for key, v in un.items()
-           if not key.startswith("pipeline_")}
-    eng["pipeline"] = {"stages": {"stacked": combined}}
-    return eng
+  # ZeRO-1 (config zero.level="v1"): the engine's grad reduction becomes
+  # a reduce-scatter to the data-axis owner (pipeline_smap._reduce_grads)
+  # — grads leave the engine data-sharded and pre-aligned with the
+  # optimizer-state shards that create_sharded_train_state(zero_level=
+  # "v1") builds, so the update applies shard-locally and GSPMD
+  # all-gathers the params: the reference's reduce-to-owner + broadcast
+  # choreography (epl/runtime/zero.py:129-190) riding the pipeline
+  # engine's own reduction.
+  zero1_dp = 0
+  if Env.get().config.zero.level == constants.ZERO_V1:
+    zero1_dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+        constants.DATA_AXIS, 1)
+    if zero1_dp <= 1:
+      zero1_dp = 0
 
-  def from_engine_grads(g):
-    if K == 1:
-      return g
-    comb = g["pipeline"]["stages"]["stacked"]
-    out = {key: v for key, v in g.items() if key != "pipeline"}
-    for k in range(K):
-      out[f"pipeline_{k}"] = {"stages": {"stacked": jax.tree_util.tree_map(
-          lambda l, k=k: l[:, k], comb)}}
-    return out
 
   def grad_fn(params, batch, rng, loss_scale=None):
+    if seq_manual and (batch["ids"].shape[1] - 1) % seq_size:
+      raise ValueError(
+          f"token count {batch['ids'].shape[1] - 1} must divide into "
+          f"{seq_size} seq shards for sequence parallelism on the "
+          "smap engine")
     un = to_engine_tree(nn.meta.unbox(params))
     if "fn" not in engine_cache:
       # Manual (stage/data) projection only: model-axis TP shardings ride
@@ -902,20 +966,31 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
       specs["wte"]["embedding"] = P(constants.STAGE_AXIS, None)
       if not cfg.tie_embeddings:
         specs["lm_head"]["kernel"] = P(None, constants.STAGE_AXIS)
-      manual = MANUAL_AXES
+      manual = (MANUAL_AXES | {constants.SEQ_AXIS} if seq_manual
+                else MANUAL_AXES)
+      bspec = (P(None, constants.DATA_AXIS, constants.SEQ_AXIS)
+               if seq_manual else None)
+      uniform = (seq_manual or a2a_moe) or None
       aux_w = cfg.moe_aux_weight if cfg.num_experts > 0 else 0.0
+      zero1 = None
+      if zero1_dp:
+        dims, gspecs = zero1_grad_layout(
+            un, engine_meta_specs(params, K), specs, zero1_dp)
+        zero1 = (dims, gspecs, zero1_dp)
       if schedule == "interleaved":
         from easyparallellibrary_tpu.parallel.pipeline_interleaved import (
             make_smap_interleaved_grad_fn)
         engine_cache["fn"] = make_smap_interleaved_grad_fn(
             feed_fn, stage_fn, emit_fn, S, K, M, mesh, specs,
-            manual_axes=manual, stage_aux_weight=aux_w)
+            batch_spec=bspec, manual_axes=manual, stage_aux_weight=aux_w,
+            uniform_compute=uniform, zero1=zero1)
       else:
         build = (make_smap_1f1b_grad_fn if schedule == "1f1b"
                  else make_smap_gpipe_grad_fn)
         engine_cache["fn"] = build(
             feed_fn, stage_fn, emit_fn, S, M, mesh, specs,
-            manual_axes=manual, stage_aux_weight=aux_w)
+            batch_spec=bspec, manual_axes=manual, stage_aux_weight=aux_w,
+            uniform_compute=uniform, zero1=zero1)
     ids = batch["ids"]
     mbs = split_micro_batches(
         {"inputs": ids[:, :-1], "targets": ids[:, 1:]}, M)

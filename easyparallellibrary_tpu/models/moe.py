@@ -105,6 +105,31 @@ class MoEMLP(nn.Module):
     E = cfg.num_experts
     F = cfg.d_ff
     T = B * S
+
+    # Perf-cliff flag (docs/parallelism.md "Expert parallelism"): with
+    # tokens replicated over the expert group — this model's activations
+    # shard tokens over data/seq only — GSPMD lowers the dispatch/
+    # combine einsums to local-compute + reductions, NOT all-to-alls:
+    # every expert-group member touches every token, so EP stops scaling
+    # compute with the expert axis (measured: benchmarks/
+    # moe_a2a_share.py).  moe_impl="a2a" enforces distributed tokens.
+    from easyparallellibrary_tpu.env import Env
+    env = Env.get()
+    if env.cluster is not None and env.cluster._mesh is not None:
+      sizes = dict(zip(env.cluster.mesh.axis_names,
+                       env.cluster.mesh.devices.shape))
+      if sizes.get(constants.EXPERT_AXIS, 1) > 1:
+        from easyparallellibrary_tpu.utils.logging import get_logger
+        get_logger().info(
+            "MoE impl='einsum' on an expert axis of size %d: IF tokens "
+            "are replicated over the expert group (the default when the "
+            "batch shards over 'data' alone), GSPMD local-computes "
+            "dispatch/combine with no all-to-all — every expert-group "
+            "member touches every token.  Shard the batch over "
+            "('data','expert') or use moe_impl='a2a' for "
+            "distributed-token expert parallelism.  See "
+            "docs/parallelism.md.",
+            sizes[constants.EXPERT_AXIS])
     capacity = max(self.top_k, int(
         math.ceil(T / E * cfg.capacity_factor)))
 
@@ -244,8 +269,17 @@ class MoEMLP(nn.Module):
       aux = E * jnp.sum(frac_tokens * frac_probs)
       return out, aux
 
+    # Inside a manual region (the smap pipeline engines) the nested map
+    # must be built against the ABSTRACT context mesh — the concrete
+    # Mesh has no Manual axis types and shard_map rejects the mismatch.
+    # The engines run stage compute branch-uniformly for this
+    # composition (models/gpt.py), so the nested map's whole-mesh
+    # collective channels are never gated.
+    from easyparallellibrary_tpu.utils.sharding import manual_axes
+    smap_mesh = (jax.sharding.get_abstract_mesh() if manual_axes()
+                 else mesh)
     mapped = jax.shard_map(
-        local_moe, mesh=mesh,
+        local_moe, mesh=smap_mesh,
         in_specs=(P(constants.EXPERT_AXIS), P(),
                   P(constants.EXPERT_AXIS), P(constants.EXPERT_AXIS)),
         out_specs=(P(constants.EXPERT_AXIS), P()),

@@ -38,12 +38,20 @@ Two schedules:
     stage-compute instead of a dead fwd+bwd pair.  Residual ring bound
     min(M, 2S-1) per stage, as in the vmap engine.
 
-Collective-safety invariant: every collective (ppermute, psum, pmax)
-executes unconditionally on every tick on every device; only *local*
-compute sits inside ``cond`` branches.  Stage-axis peers may take
-different branches, but ``model``/``data``-axis peers always share a
-stage index and therefore a predicate, so collectives over those axes
-inside a stage function remain safe.
+Collective-safety invariant: a collective may sit inside a ``cond``
+branch ONLY if every device in its lowered channel takes the same
+branch.  Two forms satisfy it here: (a) per-DEVICE predicates gate only
+local compute plus collectives whose peers share the predicate
+(``model``/``data``/``expert``-axis peers share a stage index — their
+GSPMD all-reduces get per-replica-group rendezvous); (b) TICK-GLOBAL
+predicates (feed ``t < M``, emit ``valid_e``, feed-VJP ``valid_fb`` —
+functions of the tick alone) gate the boundary evaluations uniformly on
+every device, so their stage psums execute only on the ticks that need
+them.  Everything else (the ring ppermutes, the grad reductions) runs
+unconditionally — collective-permute and all-to-all lower to a single
+whole-mesh channel and deadlock under ANY divergent gating, which is
+also why the seq-manual/a2a-MoE modes force branch-uniform stage
+compute (:func:`uniform_stage_compute`).
 
 Tensor parallelism composes via *partial-manual* shard_map
 (``manual_axes``): the engine is manual over ``stage`` (and ``data``)
@@ -167,6 +175,41 @@ def stage_stacked_specs(un):
   return specs
 
 
+def make_engine_tree_fns(K: int):
+  """(to_engine_tree, from_engine_grads) for the interleaved engine's
+  stacked-parameter convention — shared by the GPT and BERT wirings so
+  the K-pass layout cannot drift between model families.
+
+  K=1: both are the identity.  K>1: the model's K pipeline passes
+  (param sub-trees ``pipeline_0`` .. ``pipeline_{K-1}``, each with
+  stage-stacked leaves at ``["stages"]["stacked"]``) are stacked on
+  axis 1 of each leaf ([S, K, ...] globally — dim 0 stays the stage
+  split) under the single ``pipeline`` path the K=1 tree uses.  Pass k
+  row d is virtual stage k*S + d, so the contiguous stage split already
+  realizes Megatron's circular placement — no permutation."""
+  if K == 1:
+    return (lambda un: un), (lambda g: g)
+
+  def to_engine_tree(un):
+    passes = [un[f"pipeline_{k}"]["stages"]["stacked"] for k in range(K)]
+    combined = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls, axis=1), *passes)
+    eng = {key: v for key, v in un.items()
+           if not key.startswith("pipeline_")}
+    eng["pipeline"] = {"stages": {"stacked": combined}}
+    return eng
+
+  def from_engine_grads(g):
+    comb = g["pipeline"]["stages"]["stacked"]
+    out = {key: v for key, v in g.items() if key != "pipeline"}
+    for k in range(K):
+      out[f"pipeline_{k}"] = {"stages": {"stacked": jax.tree_util.tree_map(
+          lambda l, k=k: l[:, k], comb)}}
+    return out
+
+  return to_engine_tree, from_engine_grads
+
+
 def check_unpadded_vocab(vocab_size: int, mesh: Mesh) -> None:
   """TP + stage-resident CE requires an unpadded vocab table: padded
   rows would corrupt the collectively-computed normalizer."""
@@ -205,6 +248,134 @@ def rebox_grads(params, g):
 MANUAL_AXES = frozenset({constants.STAGE_AXIS, constants.DATA_AXIS})
 
 
+def engine_meta_specs(params, K: int):
+  """Full global spec per ENGINE-tree leaf, from the boxed params'
+  metadata (covers the auto axes — TP dims — that the engines' manual
+  specs do not).  For K > 1 the K passes stack at axis 1 exactly like
+  :func:`make_engine_tree_fns`; the inserted chunk axis is marked
+  ineligible (``"_chunk"``) so :func:`zero1_grad_layout`'s owner-dim
+  choice matches ``runtime.zero.shard_opt_state``'s choice on the
+  per-pass param leaves.  Shared by the GPT and BERT wirings."""
+  import flax.linen as nn
+  meta = nn.get_partition_spec(params)
+  if K == 1:
+    return meta
+  passes = [meta[f"pipeline_{k}"]["stages"]["stacked"] for k in range(K)]
+
+  def stack_spec(s, *_rest):
+    ent = list(s)
+    head = ent[:1] if ent else [None]
+    return tuple(head + ["_chunk"] + ent[1:])
+
+  combined = jax.tree_util.tree_map(
+      stack_spec, *passes, is_leaf=lambda x: isinstance(x, P))
+  eng = {k2: v for k2, v in meta.items()
+         if not k2.startswith("pipeline_")}
+  eng["pipeline"] = {"stages": {"stacked": combined}}
+  return eng
+
+
+def zero1_grad_layout(un_engine, full_specs_engine, manual_specs, dp):
+  """ZeRO-1 owner layout for the engines' gradient outputs.
+
+  Returns ``(dims, out_specs)``: per leaf, the dimension its gradient is
+  reduce-SCATTERED over the data axis to (-1 = stays pmean'd/
+  replicated; None is not a pytree leaf), plus the engine out-spec tree
+  with the data axis added at that dimension.  The dim choice replicates
+  ``runtime.zero._shard_leaf_spec`` — first dimension that is unsharded
+  in the FULL global spec (manual stage entries merged with the
+  metadata's auto-axis entries, so TP dims are skipped) and divisible by
+  ``dp`` — which is exactly the rule ``shard_opt_state`` uses for the
+  v0/v1 optimizer-state layout, so the engine's scattered grads land
+  pre-aligned with the owner's optimizer shard and GSPMD inserts no
+  resharding between them.
+  """
+  def choose(leaf, full_spec, manual_spec):
+    shape = getattr(leaf, "shape", ())
+    if not shape or dp <= 1:
+      return -1, manual_spec
+    entries = list(full_spec) + [None] * (len(shape) - len(full_spec))
+    man = list(manual_spec) + [None] * (len(shape) - len(manual_spec))
+    for dim, size in enumerate(shape):
+      taken = entries[dim] is not None or man[dim] is not None
+      if not taken and size % dp == 0 and size >= dp:
+        man[dim] = constants.DATA_AXIS
+        return dim, P(*man)
+    return -1, manual_spec
+
+  pairs = jax.tree_util.tree_map(
+      choose, un_engine, full_specs_engine, manual_specs,
+      is_leaf=lambda x: isinstance(x, P))
+  dims = jax.tree_util.tree_map(lambda pr: pr[0], pairs,
+                                is_leaf=lambda x: isinstance(x, tuple))
+  out_specs = jax.tree_util.tree_map(lambda pr: pr[1], pairs,
+                                     is_leaf=lambda x: isinstance(x, tuple))
+  return dims, out_specs
+
+
+def uniform_stage_compute(manual_axes) -> bool:
+  """True when stage compute must run branch-UNIFORMLY (select, not
+  lax.cond): the seq-manual engines (ring sequence parallelism) carry
+  seq-axis ppermutes inside the stage function, and XLA lowers
+  collective-permute to a single channel spanning the whole mesh — only
+  all-reduce gets per-replica-group rendezvous — so a ramp tick where
+  one stage group skips the branch deadlocks the permute (observed:
+  rendezvous termination with global_devices=[all]).  Running the stage
+  function every tick and selecting its output restores the vmapped
+  engines' uniform-work semantics for exactly this case; the real-branch
+  FLOP skip remains everywhere else."""
+  return manual_axes is not None and constants.SEQ_AXIS in manual_axes
+
+
+def _reduce_grads(G, stage_psum, mean_axes, zero1):
+  """The engines' shared cross-device gradient reduction.
+
+  ``zero1 = None``: stage-psum where flagged, then pmean over
+  ``mean_axes`` (data, + seq under seq-manual).  ``zero1 = (dims,
+  out_specs, dp)``: divisible leaves are ``psum_scatter``'d to their
+  data-axis owner dim (``dims`` leaf >= 0) instead of all-reduced —
+  the explicit ZeRO-1 reduce-to-owner with half the wire bytes; the
+  remaining leaves keep the pmean."""
+  seq_mean = tuple(a for a in mean_axes if a != constants.DATA_AXIS)
+  dims, _, dp = zero1 if zero1 is not None else (None, None, 0)
+
+  def reduce_leaf(g, needs_stage_psum, zdim=-1):
+    if needs_stage_psum:
+      g = jax.lax.psum(g, constants.STAGE_AXIS)
+    if zdim >= 0:
+      if seq_mean:
+        g = jax.lax.pmean(g, seq_mean)
+      return jax.lax.psum_scatter(
+          g, constants.DATA_AXIS, scatter_dimension=zdim, tiled=True) / dp
+    return jax.lax.pmean(g, mean_axes)
+
+  if dims is None:
+    return jax.tree_util.tree_map(
+        lambda g, n: reduce_leaf(g, n), G, stage_psum)
+  return jax.tree_util.tree_map(reduce_leaf, G, stage_psum, dims)
+
+
+def grad_out_specs(param_specs, zero1):
+  """The engines' gradient out-spec tree: param layout, or the ZeRO-1
+  owner-scattered layout when ``zero1`` is active."""
+  return param_specs if zero1 is None else zero1[1]
+
+
+def grad_mean_axes(manual_axes) -> tuple:
+  """Axes the engines batch-average parameter grads over: always
+  ``data``, plus ``seq`` when the engine is manual over it (ring
+  sequence parallelism on the smap engines).  Tokens partition the
+  per-micro-batch loss mean exactly like batch elements partition it
+  over ``data``, so each seq peer's local grads are per-shard means and
+  the pmean over ``seq`` recovers the global-token gradient (the emit
+  loss itself is already seq-identical — emit_fn pmeans it — so only
+  the grads need this)."""
+  axes = (constants.DATA_AXIS,)
+  if manual_axes is not None and constants.SEQ_AXIS in manual_axes:
+    axes = axes + (constants.SEQ_AXIS,)
+  return axes
+
+
 # ------------------------------------------------------------------- engine
 
 def make_smap_gpipe_grad_fn(feed_fn: Callable,
@@ -218,6 +389,8 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
                             batch_spec: Optional[P] = None,
                             manual_axes: Optional[frozenset] = None,
                             stage_aux_weight: float = 0.0,
+                            uniform_compute: Optional[bool] = None,
+                            zero1=None,
                             check_specs=None) -> Callable:
   """Build the shard_map pipeline gradient function.
 
@@ -264,6 +437,9 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
       None, constants.DATA_AXIS)
 
   stage_psum = _stage_psum_specs(param_specs)
+  mean_axes = grad_mean_axes(manual_axes)
+  uniform = (uniform_stage_compute(manual_axes)
+             if uniform_compute is None else uniform_compute)
 
   def local_grad(p_loc, mbs_loc, rng):
     s_idx = jax.lax.axis_index(constants.STAGE_AXIS)
@@ -279,7 +455,12 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
         m_f = jnp.clip(t, 0, M - 1)
         feed_rng = (None if rng is None
                     else jax.random.fold_in(rng, S * M + m_f))
-        x_fed = feed_fn(p, mb_at(m_f), feed_rng)
+        # Feed gated on the TICK-GLOBAL predicate t < M (uniform branch
+        # on every device — its stage psum stays rendezvous-safe) so
+        # ramp-down ticks skip the lookup+psum entirely.
+        x_fed = jax.lax.cond(
+            t < M, lambda _: feed_fn(p, mb_at(m_f), feed_rng),
+            lambda _: jnp.zeros(x0.shape, x0.dtype), None)
         x_in = jnp.where(s_idx == 0, x_fed, x_recv)
 
         m_s = t - s_idx
@@ -287,22 +468,33 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
         st_rng = (None if rng is None
                   else jax.random.fold_in(
                       rng, jnp.clip(m_s, 0, M - 1) * S + s_idx))
-        y, aux_s = jax.lax.cond(
-            valid_f, lambda op: stage_fn(p, op, st_rng),
-            lambda op: (op, jnp.float32(0)), x_in)
+        if uniform:
+          y_run, aux_s = stage_fn(p, x_in, st_rng)
+          y = jnp.where(valid_f, y_run, x_in)
+        else:
+          y, aux_s = jax.lax.cond(
+              valid_f, lambda op: stage_fn(p, op, st_rng),
+              lambda op: (op, jnp.float32(0)), x_in)
         aux_sum = aux_sum + jnp.where(valid_f, aux_s, 0.0)
 
-        y_b = jax.lax.psum(
-            jnp.where(s_idx == S - 1, y, jnp.zeros_like(y)),
-            constants.STAGE_AXIS)
         m_e = t - (S - 1)
         valid_e = (m_e >= 0) & (m_e < M)
         me = jnp.clip(m_e, 0, M - 1)
         emit_rng = (None if rng is None
                     else jax.random.fold_in(rng, S * M + M + me))
-        loss_e = emit_fn(p, y_b, mb_at(me), valid_e, emit_rng)
-        loss_sum = loss_sum + jnp.where(valid_e,
-                                        loss_e.astype(jnp.float32), 0.0)
+
+        # Emit gated on the TICK-GLOBAL valid_e (uniform branch): the
+        # psum + CE collectives execute on the M emitting ticks only.
+        def do_emit(_):
+          y_b = jax.lax.psum(
+              jnp.where(s_idx == S - 1, y, jnp.zeros_like(y)),
+              constants.STAGE_AXIS)
+          return emit_fn(p, y_b, mb_at(me), valid_e,
+                         emit_rng).astype(jnp.float32)
+
+        loss_e = jax.lax.cond(valid_e, do_emit,
+                              lambda _: jnp.float32(0), None)
+        loss_sum = loss_sum + loss_e
         return (y, loss_sum, aux_sum), None
 
       mb0 = mb_at(0)
@@ -330,6 +522,8 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
     loss = loss_sum / M
     if stage_aux_weight:
       aux_total = jax.lax.psum(aux_sum, constants.STAGE_AXIS) / M
+      if constants.SEQ_AXIS in mean_axes:
+        aux_total = jax.lax.pmean(aux_total, constants.SEQ_AXIS)
       loss = loss + jnp.float32(stage_aux_weight) * aux_total
     else:
       # Keep the non-aux hot path free of the reporting psum.
@@ -338,13 +532,13 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
     # Cross-device grad reductions: stage-replicated leaves carry only
     # this stage's contribution -> psum over stage; everything is
     # averaged over data replicas (the reference's fused allreduce,
-    # epl/parallel/graph_editor.py:670-725 — here one explicit pmean).
-    def reduce_leaf(g, needs_stage_psum):
-      if needs_stage_psum:
-        g = jax.lax.psum(g, constants.STAGE_AXIS)
-      return jax.lax.pmean(g, constants.DATA_AXIS)
-
-    grads = jax.tree_util.tree_map(reduce_leaf, grads, stage_psum)
+    # epl/parallel/graph_editor.py:670-725 — here one explicit pmean)
+    # and, under seq-manual sequence parallelism, over token shards too
+    # (see grad_mean_axes).  Under ZeRO-1 (`zero1`), divisible leaves
+    # are reduce-SCATTERED to their data-axis owner instead — half the
+    # wire bytes of the all-reduce, and the grads leave the engine
+    # pre-aligned with the v1 optimizer-state shards (zero1_grad_layout).
+    grads = _reduce_grads(grads, stage_psum, mean_axes, zero1)
     loss = jax.lax.pmean(loss, constants.DATA_AXIS)
     metrics = {"stage_aux_loss": jax.lax.pmean(aux_total,
                                                constants.DATA_AXIS)}
@@ -353,7 +547,8 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
   mapped = jax.shard_map(
       local_grad, mesh=mesh,
       in_specs=(param_specs, bspec, P()),
-      out_specs=((P(), {"stage_aux_loss": P()}), param_specs),
+      out_specs=((P(), {"stage_aux_loss": P()}),
+                 grad_out_specs(param_specs, zero1)),
       axis_names=manual_axes if manual_axes is not None else frozenset(),
       check_vma=False)
 
@@ -373,7 +568,9 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
                            *,
                            batch_spec: Optional[P] = None,
                            manual_axes: Optional[frozenset] = None,
-                           stage_aux_weight: float = 0.0
+                           stage_aux_weight: float = 0.0,
+                           uniform_compute: Optional[bool] = None,
+                           zero1=None
                            ) -> Callable:
   """True-1F1B shard_map pipeline gradient function.
 
@@ -405,6 +602,9 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
   bspec = batch_spec if batch_spec is not None else P(
       None, constants.DATA_AXIS)
   stage_psum = _stage_psum_specs(param_specs)
+  mean_axes = grad_mean_axes(manual_axes)
+  uniform = (uniform_stage_compute(manual_axes)
+             if uniform_compute is None else uniform_compute)
   fwd_perm = _fwd_perm(S)
   bwd_perm = [(i + 1, i) for i in range(S - 1)]
 
@@ -435,7 +635,13 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
       feed_rng = (None if rng is None
                   else jax.random.fold_in(rng, S * M + jnp.clip(t, 0,
                                                                 M - 1)))
-      x_fed = feed_fn(params, mb_at(jnp.clip(t, 0, M - 1)), feed_rng)
+      # Feed gated on the TICK-GLOBAL t < M (uniform branch on every
+      # device) — ramp-down ticks skip the lookup + stage psum.
+      x_fed = jax.lax.cond(
+          t < M,
+          lambda _: feed_fn(params, mb_at(jnp.clip(t, 0, M - 1)),
+                            feed_rng),
+          lambda _: zeros_x, None)
       x_recv = jax.lax.ppermute(F, constants.STAGE_AXIS, fwd_perm)
       x_in = jnp.where(s_idx == 0, x_fed, x_recv)
       # Residual ring write, slot keyed by micro-batch id.
@@ -443,16 +649,19 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
       R = jnp.where(
           valid_f,
           jax.lax.dynamic_update_index_in_dim(R, x_in, slot_w, 0), R)
-      Y, aux_s = jax.lax.cond(
-          valid_f, lambda op: stage_fn(params, op, st_rng(mf)),
-          lambda op: (op, jnp.float32(0)), x_in)
+      if uniform:
+        y_run, aux_s = stage_fn(params, x_in, st_rng(mf))
+        Y = jnp.where(valid_f, y_run, x_in)
+      else:
+        Y, aux_s = jax.lax.cond(
+            valid_f, lambda op: stage_fn(params, op, st_rng(mf)),
+            lambda op: (op, jnp.float32(0)), x_in)
       aux_sum = aux_sum + jnp.where(valid_f, aux_s, 0.0)
 
       # ---- emit sub-tick: loss + cotangent for the micro-batch leaving
-      # the last stage (its backward starts this tick) ----
-      y_b = jax.lax.psum(
-          jnp.where(s_idx == S - 1, Y, jnp.zeros_like(Y)),
-          constants.STAGE_AXIS)
+      # the last stage (its backward starts this tick).  Gated on the
+      # TICK-GLOBAL valid_e (uniform branch on every device), so the
+      # psum + CE collectives execute on the M emitting ticks only. ----
       m_e = t - (S - 1)
       valid_e = (m_e >= 0) & (m_e < M)
       me = jnp.clip(m_e, 0, M - 1)
@@ -460,21 +669,29 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
                   else jax.random.fold_in(rng, S * M + M + me))
       emit_mb = mb_at(me)
 
-      def emit_wrap(p, y):
-        return emit_fn(p, y, emit_mb, valid_e, emit_rng)
+      def do_emit(_):
+        y_b = jax.lax.psum(
+            jnp.where(s_idx == S - 1, Y, jnp.zeros_like(Y)),
+            constants.STAGE_AXIS)
 
-      loss_e, emit_vjp = jax.vjp(emit_wrap, params, y_b)
-      # 1/S share seed: every device seeds the collectively-computed
-      # loss, and the CE psums transpose to psum (see the GPipe engine's
-      # share scaling) — the psum of dy_local below then lands at 1x.
-      dEp, dy_local = emit_vjp((seed / S).astype(loss_e.dtype))
-      dy = jax.lax.psum(dy_local, constants.STAGE_AXIS)
-      dy = jnp.where(valid_e, dy, jnp.zeros_like(dy))
-      loss_sum = loss_sum + jnp.where(valid_e,
-                                      loss_e.astype(jnp.float32), 0.0)
-      G = jax.tree_util.tree_map(
-          lambda g, d: g + jnp.where(valid_e, d, jnp.zeros_like(d)),
-          G, dEp)
+        def emit_wrap(p, yy):
+          return emit_fn(p, yy, emit_mb, valid_e, emit_rng)
+
+        loss_e, emit_vjp = jax.vjp(emit_wrap, params, y_b)
+        # 1/S share seed: every device seeds the collectively-computed
+        # loss, and the CE psums transpose to psum (see the GPipe
+        # engine's share scaling) — the psum of dy_local below then
+        # lands at 1x.
+        dEp, dy_local = emit_vjp((seed / S).astype(loss_e.dtype))
+        return (loss_e.astype(jnp.float32), dEp,
+                jax.lax.psum(dy_local, constants.STAGE_AXIS))
+
+      def no_emit(_):
+        return jnp.float32(0), zeros_g, jnp.zeros_like(Y)
+
+      loss_e, dEp, dy = jax.lax.cond(valid_e, do_emit, no_emit, None)
+      loss_sum = loss_sum + loss_e
+      G = jax.tree_util.tree_map(jnp.add, G, dEp)
 
       # ---- backward sub-tick: this stage retires one micro-batch ----
       m_b = t - 2 * (S - 1) + s_idx
@@ -499,20 +716,33 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
       def bwd_zero(_):
         return zeros_g, jnp.zeros_like(x_res)
 
-      dP, dX = jax.lax.cond(valid_b, bwd, bwd_zero, None)
+      if uniform:
+        dP_r, dX_r = bwd(None)
+        dP = jax.tree_util.tree_map(
+            lambda g: jnp.where(valid_b, g, jnp.zeros_like(g)), dP_r)
+        dX = jnp.where(valid_b, dX_r, jnp.zeros_like(dX_r))
+      else:
+        dP, dX = jax.lax.cond(valid_b, bwd, bwd_zero, None)
       G = jax.tree_util.tree_map(jnp.add, G, dP)
 
-      # ---- feed backward: the wave exits stage 0 ----
+      # ---- feed backward: the wave exits stage 0.  Gated on the
+      # TICK-GLOBAL valid_fb (its psum transpose is a stage
+      # collective). ----
       m_fb = t - 2 * (S - 1)
       valid_fb = (m_fb >= 0) & (m_fb < M)
       fbc = jnp.clip(m_fb, 0, M - 1)
       fb_rng = (None if rng is None
                 else jax.random.fold_in(rng, S * M + fbc))
-      _, feed_vjp = jax.vjp(
-          lambda p: feed_fn(p, mb_at(fbc), fb_rng), params)
-      ct_feed = jnp.where((s_idx == 0) & valid_fb, dX,
-                          jnp.zeros_like(dX))
-      (dFp,) = feed_vjp(ct_feed)
+
+      def do_fb(_):
+        _, feed_vjp = jax.vjp(
+            lambda p: feed_fn(p, mb_at(fbc), fb_rng), params)
+        ct_feed = jnp.where((s_idx == 0) & valid_fb, dX,
+                            jnp.zeros_like(dX))
+        (dFp,) = feed_vjp(ct_feed)
+        return dFp
+
+      dFp = jax.lax.cond(valid_fb, do_fb, lambda _: zeros_g, None)
       G = jax.tree_util.tree_map(jnp.add, G, dFp)
 
       return (Y, R, dX, G, loss_sum, aux_sum), None
@@ -527,15 +757,12 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
     G = jax.tree_util.tree_map(
         lambda g: g * g_scale.astype(g.dtype), G)
 
-    def reduce_leaf(g, needs_stage_psum):
-      if needs_stage_psum:
-        g = jax.lax.psum(g, constants.STAGE_AXIS)
-      return jax.lax.pmean(g, constants.DATA_AXIS)
-
-    G = jax.tree_util.tree_map(reduce_leaf, G, stage_psum)
+    G = _reduce_grads(G, stage_psum, mean_axes, zero1)
     loss_local = loss_sum / M
     if stage_aux_weight:
       aux_total = jax.lax.psum(aux_sum, constants.STAGE_AXIS) / M
+      if constants.SEQ_AXIS in mean_axes:
+        aux_total = jax.lax.pmean(aux_total, constants.SEQ_AXIS)
       loss_local = loss_local + jnp.float32(stage_aux_weight) * aux_total
     else:
       # Keep the non-aux hot path free of the reporting psum.
@@ -548,7 +775,8 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
   mapped = jax.shard_map(
       local_grad, mesh=mesh,
       in_specs=(param_specs, bspec, P(), P()),
-      out_specs=((P(), {"stage_aux_loss": P()}), param_specs),
+      out_specs=((P(), {"stage_aux_loss": P()}),
+                 grad_out_specs(param_specs, zero1)),
       axis_names=manual_axes if manual_axes is not None else frozenset(),
       check_vma=False)
 

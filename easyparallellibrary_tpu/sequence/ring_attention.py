@@ -51,7 +51,8 @@ from easyparallellibrary_tpu.env import Env
 NEG_INF = -1e30
 
 
-from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
+from easyparallellibrary_tpu.utils.sharding import (  # noqa: E402
+    constrain as _constrain, manual_axes as _manual_axes)
 
 
 def _seq_axis_size() -> int:
@@ -506,6 +507,57 @@ def _ring_local_zz_bwd(n, residuals, dO):
 _ring_local_zz.defvjp(_ring_local_zz_fwd, _ring_local_zz_bwd)
 
 
+def _ring_manual(q, k, v, causal: bool):
+  """Per-device ring body for callers ALREADY inside a shard_map region
+  that is manual over the seq axis (the smap pipeline engines' stage
+  programs, models/gpt.py:make_gpt_smap_grad_fn): q/k/v arrive
+  seq-LOCAL ``[B_loc, s, H, D]`` and the ring's ppermutes execute
+  directly in the ambient region — no nested shard_map.
+
+  Deadlock-safe by the engines' collective-safety invariant
+  (parallel/pipeline_smap.py module docstring): seq peers share a stage
+  index, hence identical branch predicates, so every device in a
+  seq-axis channel reaches each collective together.  (The round-4
+  hazard was a NESTED shard_map, whose lowered channels span all
+  devices regardless of the outer grouping.)  Requires ring_impl
+  "flash"/"dense" — the einsum ring is a global-array GSPMD program and
+  cannot run on local shards.
+  """
+  env = Env.get()
+  n = env.cluster.axis_size(constants.SEQ_AXIS)
+  seq_cfg = env.config.sequence
+  from easyparallellibrary_tpu.kernels.flash_attention import (
+      flash_blockable)
+  s_loc, D = q.shape[1], q.shape[3]
+  if seq_cfg.ring_impl not in ("flash", "dense"):
+    raise ValueError(
+        f"sequence.ring_impl={seq_cfg.ring_impl!r} cannot run inside a "
+        "seq-manual region (the einsum ring is a global-array GSPMD "
+        "program); use ring_impl='flash' or 'dense' with the smap "
+        "pipeline engine")
+  dense = _use_dense_blocks()
+  zigzag = (seq_cfg.ring_layout == "zigzag" and causal and n > 1
+            and s_loc % 2 == 0
+            and (dense or flash_blockable(s_loc // 2, d=D,
+                                          itemsize=q.dtype.itemsize)))
+  if not dense and not zigzag and not flash_blockable(
+      s_loc, d=D, itemsize=q.dtype.itemsize):
+    raise ValueError(
+        f"per-device sequence block {s_loc} (d={D}) has no flash "
+        "tiling; set sequence.ring_impl='dense' for the XLA block path "
+        "inside the smap engine")
+  qt = q.transpose(0, 2, 1, 3)
+  kt = k.transpose(0, 2, 1, 3)
+  vt = v.transpose(0, 2, 1, 3)
+  if zigzag:
+    qt, kt, vt = (_zig_entry(x, n) for x in (qt, kt, vt))
+    out = _ring_local_zz(n, qt, kt, vt)
+    out = _zig_exit(out, n)
+  else:
+    out = _ring_local(n, causal, qt, kt, vt)
+  return out.transpose(0, 2, 1, 3)
+
+
 def _ring_flash(q, k, v, causal: bool):
   env = Env.get()
   mesh = env.cluster._mesh
@@ -533,23 +585,25 @@ def _ring_flash(q, k, v, causal: bool):
       out = _ring_local(n, causal, qt, kt, vt)
     return out.transpose(0, 2, 1, 3)
 
-  # Inside another manual region (the smap pipeline engine's stage
-  # program) the ring is NOT safe: nesting compiles (abstract-mesh
-  # shard_map over the seq axis works), but the ring's ppermutes would
-  # then execute inside the engine's real `lax.cond` branches, and stage
-  # groups take different branches at ramp ticks — half the devices
-  # never reach the shared-channel collective and the program deadlocks
-  # (observed as an XLA rendezvous termination).  Fail loudly instead.
-  from easyparallellibrary_tpu.utils.sharding import manual_axes
-  outer_manual = manual_axes()
+  # Inside a manual region that is NOT manual over seq, the ring cannot
+  # run: nesting a shard_map compiles (abstract-mesh shard_map over the
+  # seq axis works), but the NESTED map's collectives get lowered
+  # channels spanning ALL devices, so when the region's real `lax.cond`
+  # branches diverge across stage groups (ramp ticks) half the devices
+  # never reach the collective and the program deadlocks (observed as an
+  # XLA rendezvous termination).  The supported in-region path is the
+  # seq-manual engine (handled in ring_attention -> _ring_manual, where
+  # the ppermutes ride the AMBIENT region and channels stay seq-local).
+  outer_manual = _manual_axes()
   if outer_manual:
     raise ValueError(
-        "ring attention cannot run inside a manual shard_map region "
-        f"(manual axes {sorted(outer_manual)}): its seq-axis collectives "
-        "would be gated by the region's branches and deadlock.  Use the "
-        "vmapped pipeline engines (pipeline.engine='' ) with "
-        "sequence parallelism, or attn_impl='pallas_flash'/'xla' on the "
-        "smap engine.")
+        "ring attention cannot nest inside a manual shard_map region "
+        f"without the seq axis (manual axes {sorted(outer_manual)}): a "
+        "nested map's collective channels span all devices and deadlock "
+        "under divergent branches.  Make the region manual over "
+        f"{constants.SEQ_AXIS!r} too (the smap engines do this when "
+        "attn_impl='ring'), or use the vmapped pipeline engines "
+        "(pipeline.engine=''), or attn_impl='pallas_flash'/'xla'.")
 
   # Batch on data, sequence on seq, heads on model (survives TP head
   # sharding); stage/expert axes replicated.
@@ -574,6 +628,11 @@ def ring_attention(q, k, v, causal: bool = True,
   blocking via ``sequence.block_size``).  Falls back to one block
   (= standard blockwise attention) when no seq axis is active."""
   B, S, H, D = q.shape
+  # Inside a seq-manual shard_map region (the smap pipeline engines) the
+  # arrays are already per-device shards: run the ring body directly in
+  # the ambient region (see _ring_manual).
+  if constants.SEQ_AXIS in _manual_axes():
+    return _ring_manual(q, k, v, causal)
   axis = max(_seq_axis_size(), 1)
   seq_cfg = Env.get().config.sequence
   if (axis > 1 and num_blocks is None

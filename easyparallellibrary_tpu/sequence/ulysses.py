@@ -38,6 +38,57 @@ SEQ_SHARDED = P(constants.DATA_AXIS, constants.SEQ_AXIS, None, None)
 HEAD_SHARDED = P(constants.DATA_AXIS, None, constants.SEQ_AXIS, None)
 
 
+def _dense_full_attention(q, k, v, causal: bool):
+  """Full-sequence dense attention ([B, S, H, D] -> same): bf16 matmuls,
+  fp32 softmax, optional causal mask.  Shared by the GSPMD einsum path
+  and the in-region (_ulysses_manual) path so the two cannot drift."""
+  S, D = q.shape[1], q.shape[3]
+  scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+  scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+  if causal:
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+  probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+  return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _ulysses_manual(q, k, v, causal: bool):
+  """Per-device Ulysses for callers ALREADY inside a shard_map region
+  manual over the seq axis (the smap pipeline engines' stage programs):
+  the two head<->seq re-shards are explicit ``lax.all_to_all``s in the
+  ambient region.  The engines run stage compute branch-UNIFORMLY in
+  seq-manual mode (pipeline_smap.uniform_stage_compute), so the
+  all-to-alls execute every tick on every device — the nested-shard_map
+  channel hazard never arises.
+
+  q/k/v: seq-local ``[B_loc, s, H, D]`` -> all-to-all #1 gives the FULL
+  sequence for H/n heads; attention runs locally; all-to-all #2
+  restores sequence sharding.
+  """
+  env = Env.get()
+  n = env.cluster.axis_size(constants.SEQ_AXIS)
+
+  def a2a_heads(x):        # [B, s, H, D] -> [B, s*n, H/n, D]
+    return jax.lax.all_to_all(x, constants.SEQ_AXIS, split_axis=2,
+                              concat_axis=1, tiled=True)
+
+  def a2a_seq(x):          # [B, s*n, H/n, D] -> [B, s, H, D]
+    return jax.lax.all_to_all(x, constants.SEQ_AXIS, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+  qh, kh, vh = a2a_heads(q), a2a_heads(k), a2a_heads(v)
+  S, D = qh.shape[1], qh.shape[3]
+  impl = env.config.sequence.ulysses_impl
+  from easyparallellibrary_tpu.kernels.flash_attention import (
+      flash_attention, flash_blockable)
+  if impl == "flash" and flash_blockable(S, d=D,
+                                         itemsize=q.dtype.itemsize):
+    out = flash_attention(qh, kh, vh, causal=causal)
+  else:
+    out = _dense_full_attention(qh, kh, vh, causal)
+  return a2a_seq(out)
+
+
 def _ulysses_flash(q, k, v, causal: bool):
   """Head-sharded region as a shard_map with the Pallas flash kernel:
   GSPMD inserts all-to-all #1 to meet the shard_map's head-sharded entry
@@ -69,13 +120,16 @@ def _ulysses_flash(q, k, v, causal: bool):
   from easyparallellibrary_tpu.utils.sharding import manual_axes
   outer_manual = manual_axes()
   if outer_manual:
-    # Same hazard as ring attention: the head<->seq all-to-alls would be
-    # gated by the enclosing region's branches and deadlock.
+    # Nested-map hazard as in ring attention: a nested shard_map's
+    # collective channels span all devices.  The supported in-region
+    # path is the seq-manual engine (ulysses_attention ->
+    # _ulysses_manual, ambient-region all-to-alls).
     raise ValueError(
-        "ulysses attention cannot run inside a manual shard_map region "
-        f"(manual axes {sorted(outer_manual)}): its seq-axis all-to-alls "
-        "would be gated by the region's branches and deadlock; use the "
-        "vmapped pipeline engines for pipeline x sequence hybrids.")
+        "ulysses attention cannot nest inside a manual shard_map region "
+        f"without the seq axis (manual axes {sorted(outer_manual)}); "
+        "make the region manual over the seq axis too (the smap "
+        "engines do this when attn_impl='ulysses'), or use the vmapped "
+        "pipeline engines for pipeline x sequence hybrids.")
   out = jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
                       out_specs=spec, check_vma=False)(q, k, v)
   return _constrain(out, SEQ_SHARDED)
@@ -96,6 +150,12 @@ def ulysses_attention(q, k, v, causal: bool = True):
   if n > 1 and H % n != 0:
     raise ValueError(f"Ulysses requires num_heads ({H}) divisible by the "
                      f"seq axis size ({n})")
+  from easyparallellibrary_tpu.utils.sharding import manual_axes
+  if constants.SEQ_AXIS in manual_axes():
+    # Inside a seq-manual shard_map region (the smap pipeline engines):
+    # arrays are per-device shards, all-to-alls run in the ambient
+    # region (see _ulysses_manual).
+    return _ulysses_manual(q, k, v, causal)
   if n > 1 and Env.get().config.sequence.ulysses_impl == "flash":
     from easyparallellibrary_tpu.kernels.flash_attention import (
         flash_blockable)
@@ -110,13 +170,7 @@ def ulysses_attention(q, k, v, causal: bool = True):
   k = _constrain(k, HEAD_SHARDED)
   v = _constrain(v, HEAD_SHARDED)
 
-  scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
-  scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-  if causal:
-    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    scores = jnp.where(mask[None, None], scores, -1e30)
-  probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-  out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+  out = _dense_full_attention(q, k, v, causal)
 
   # all-to-all #2: back to sequence sharding.
   return _constrain(out, SEQ_SHARDED)

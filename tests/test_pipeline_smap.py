@@ -397,15 +397,40 @@ def test_smap_moe_interleaved_trains():
   assert losses[-1] < losses[0]
 
 
-def test_smap_moe_a2a_impl_raises():
-  env = epl.init()
-  mesh = env.cluster.build_mesh(stage=2)
-  cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=2, d_model=16,
-                  d_ff=32, max_seq_len=8, dtype=jnp.float32,
-                  pipeline_stages=2, num_micro_batch=2,
-                  num_experts=2, moe_impl="a2a")
-  with pytest.raises(ValueError, match="a2a"):
-    make_gpt_smap_grad_fn(GPT(cfg), mesh)
+def test_smap_moe_a2a_matches_einsum():
+  """moe_impl='a2a' inside the smap engine (VERDICT r4 item 4): the
+  nested expert shard_map's all-to-alls are safe because the engine
+  runs stage compute branch-uniformly for this composition — loss,
+  grads and aux must match the einsum path exactly (ample capacity)."""
+  base = dict(vocab_size=64, num_layers=8, num_heads=2, d_model=16,
+              d_ff=32, max_seq_len=8, dtype=jnp.float32,
+              pipeline_stages=2, num_micro_batch=4,
+              num_experts=4, moe_every=2, capacity_factor=8.0)
+
+  def run(impl):
+    env = epl.init()
+    mesh = env.cluster.build_mesh(stage=2, expert=2)
+    cfg = GPTConfig(**base, moe_impl=impl)
+    pp = GPT(cfg)
+    dp = mesh.devices.shape[list(mesh.axis_names).index("data")]
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64,
+                                                       (4 * dp, 9)),
+                      jnp.int32)
+    params = pp.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+    g_fn = make_gpt_smap_grad_fn(pp, mesh)
+    (l, m), g = jax.jit(lambda p: g_fn(p, {"ids": ids}, None))(params)
+    return float(l), float(m["moe_aux_loss"]), g
+
+  l_a, aux_a, g_a = run("a2a")
+  l_e, aux_e, g_e = run("einsum")
+  np.testing.assert_allclose(l_a, l_e, rtol=2e-5)
+  np.testing.assert_allclose(aux_a, aux_e, rtol=1e-4)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(
+          np.asarray(a.value if hasattr(a, "value") else a),
+          np.asarray(b.value if hasattr(b, "value") else b),
+          rtol=5e-3, atol=1e-5),
+      g_a, g_e)
 
 
 def test_smap_zero_v0_trains():
@@ -443,22 +468,29 @@ def test_smap_zero_v0_trains():
   assert all(np.isfinite(l) for l in losses) and losses[-1] < losses[0]
 
 
-def test_smap_sequence_parallel_raises():
-  """Ring/Ulysses attention on the smap engine would run seq-axis
-  collectives inside the engine's real branches and deadlock (observed
-  as an XLA rendezvous termination) — both the engine builder and the
-  ring itself refuse with named errors."""
+def test_smap_sequence_parallel_guards():
+  """The compositions that remain unsafe refuse with named errors: the
+  einsum ring is a global-array program (cannot run on the seq-manual
+  engine's local shards), and a NESTED shard_map without the seq axis
+  still deadlocks (channels span all devices) so the ring refuses to
+  nest.  (Ring/Ulysses themselves now compose — test_smap_sequence.py.)"""
   env = epl.init(epl.Config({"sequence.parallelism": "ring",
-                             "sequence.axis_size": 2}))
+                             "sequence.axis_size": 2,
+                             "sequence.ring_impl": "einsum"}))
   mesh = env.cluster.build_mesh(stage=2, seq=2)
   cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=2, d_model=16,
                   d_ff=32, max_seq_len=16, dtype=jnp.float32,
                   pipeline_stages=2, num_micro_batch=2,
                   seq_parallel=True, attn_impl="ring")
-  with pytest.raises(ValueError, match="vmapped"):
+  with pytest.raises(ValueError, match="global-array"):
     make_gpt_smap_grad_fn(GPT(cfg), mesh)
 
-  # The ring itself also refuses inside any manual region.
+  # The ring itself refuses to NEST inside a manual region that is not
+  # manual over seq (a nested map's collective channels span all
+  # devices).
+  env = epl.init(epl.Config({"sequence.parallelism": "ring",
+                             "sequence.axis_size": 2}))
+  mesh = env.cluster.build_mesh(stage=2, seq=2)
   from easyparallellibrary_tpu.sequence import ring_attention
   from jax.sharding import PartitionSpec as P
 

@@ -1,0 +1,153 @@
+"""Sequence parallelism on the shard_map pipeline engines (VERDICT r4
+item 2 — the last hole in the flagship engine's composition matrix).
+
+The engines go manual over the seq axis and run stage compute
+branch-uniformly (pipeline_smap.uniform_stage_compute), so ring
+ppermutes / Ulysses all-to-alls execute unconditionally every tick —
+XLA's collective-permute and all-to-all get a single whole-mesh channel
+(only all-reduce has per-replica-group rendezvous), so any gated
+execution deadlocks.  Numerics must match the sequential ground truth
+exactly, including the seq-axis grad pmean (grad_mean_axes) and the
+emit CE's local-token-mean -> pmean(seq) contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.models import GPT, GPTConfig
+from easyparallellibrary_tpu.models.gpt import (
+    gpt_loss, make_gpt_smap_grad_fn)
+
+
+def _check_matches_sequential(mesh_kw, cfg_kw, config_kw=None,
+                              schedule="1f1b", rtol=5e-3):
+  env = epl.init(epl.Config(dict({"sequence.ring_impl": "dense",
+                                  "sequence.ulysses_impl": "einsum"},
+                                 **(config_kw or {}))))
+  mesh = env.cluster.build_mesh(**mesh_kw)
+  base = dict(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+              d_ff=64, max_seq_len=16, dtype=jnp.float32,
+              seq_parallel=True, pipeline_stages=2, num_micro_batch=2)
+  base.update(cfg_kw)
+  pp = GPT(GPTConfig(**base))
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 17)),
+                    jnp.int32)
+  params = pp.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+  seqm = GPT(GPTConfig(**base, pipeline_debug_sequential=True))
+
+  grad_smap = make_gpt_smap_grad_fn(pp, mesh, schedule=schedule)
+  (l1, _), g1 = jax.jit(lambda p: grad_smap(p, {"ids": ids}, None))(params)
+  l2, g2 = jax.jit(jax.value_and_grad(
+      lambda p: gpt_loss(seqm, p, {"ids": ids})[0]))(params)
+  np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(
+          np.asarray(a.value if hasattr(a, "value") else a),
+          np.asarray(b.value if hasattr(b, "value") else b),
+          rtol=rtol, atol=1e-5),
+      g1, g2)
+  return float(l1)
+
+
+def test_smap_ring_matches_sequential():
+  """The headline composition: smap-1F1B x ring on a stage2 x data2 x
+  seq2 mesh (pp x dp x sp in one engine)."""
+  _check_matches_sequential(dict(stage=2, seq=2), {"attn_impl": "ring"})
+
+
+def test_smap_gpipe_ring_matches_sequential():
+  _check_matches_sequential(dict(stage=2, seq=2), {"attn_impl": "ring"},
+                            schedule="gpipe")
+
+
+def test_smap_interleaved_ring_matches_sequential():
+  """Megatron-interleaved K=2 x ring: the newest schedule composes with
+  sequence parallelism too."""
+  _check_matches_sequential(dict(stage=2, seq=2),
+                            {"attn_impl": "ring",
+                             "pipeline_interleave": 2})
+
+
+def test_smap_ring_tp_hybrid_matches_sequential():
+  """pp2 x sp2 x tp2 — pipeline, sequence AND tensor parallelism in the
+  one engine (model axis stays GSPMD-auto; ring rides the seq-manual
+  region)."""
+  _check_matches_sequential(dict(stage=2, seq=2, model=2),
+                            {"attn_impl": "ring",
+                             "tensor_parallel": True})
+
+
+def test_smap_ring_zigzag_matches_sequential():
+  """The zigzag causal layout's entry/exit ppermutes also run inside
+  the engine region."""
+  _check_matches_sequential(dict(stage=2, seq=2), {"attn_impl": "ring"},
+                            {"sequence.ring_layout": "zigzag"})
+
+
+def test_smap_ring_uneven_stages_matches_sequential():
+  """5 layers over 2 stages: the masked slot stays branch-uniform
+  (select) under seq-manual so the ring's permutes never gate."""
+  _check_matches_sequential(dict(stage=2, seq=2),
+                            {"attn_impl": "ring", "num_layers": 5})
+
+
+def test_smap_ulysses_matches_sequential():
+  _check_matches_sequential(dict(stage=2, seq=2),
+                            {"attn_impl": "ulysses"})
+
+
+def test_smap_ring_config_dispatch_trains():
+  """pipeline.engine='smap' + attn_impl='ring' through
+  make_gpt_train_step: the config-only path trains and the loss
+  decreases."""
+  import optax
+  from easyparallellibrary_tpu.models.gpt import make_gpt_train_step
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state, parallelize)
+
+  env = epl.init(epl.Config({"pipeline.engine": "smap",
+                             "sequence.parallelism": "ring",
+                             "sequence.axis_size": 2,
+                             "sequence.ring_impl": "dense"}))
+  cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+                  d_ff=64, max_seq_len=16, dtype=jnp.float32,
+                  seq_parallel=True, attn_impl="ring",
+                  pipeline_stages=2, num_micro_batch=2)
+  with epl.replicate(1):
+    model = GPT(cfg)
+  mesh = env.cluster.build_mesh(stage=2, seq=2)
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 17)),
+                    jnp.int32)
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(rng, ids[:, :-1])["params"],
+                             tx=optax.adam(1e-2))
+
+  state, shardings = create_sharded_train_state(init_fn, mesh,
+                                                jax.random.PRNGKey(0))
+  step = parallelize(make_gpt_train_step(model), mesh, shardings)
+  losses = []
+  for i in range(4):
+    state, m = step(state, {"ids": ids}, jax.random.PRNGKey(i))
+    losses.append(float(m["loss"]))
+  assert all(np.isfinite(l) for l in losses)
+  assert losses[-1] < losses[0]
+
+
+def test_smap_ring_token_divisibility_raises():
+  env = epl.init(epl.Config({"sequence.parallelism": "ring",
+                             "sequence.axis_size": 2,
+                             "sequence.ring_impl": "dense"}))
+  mesh = env.cluster.build_mesh(stage=2, seq=2)
+  cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=2, d_model=16,
+                  d_ff=32, max_seq_len=16, dtype=jnp.float32,
+                  seq_parallel=True, attn_impl="ring",
+                  pipeline_stages=2, num_micro_batch=2)
+  grad_fn = make_gpt_smap_grad_fn(GPT(cfg), mesh)
+  ids = jnp.zeros((4, 16), jnp.int32)  # 15 tokens % 2 != 0
+  with pytest.raises(ValueError, match="seq shards"):
+    grad_fn(None, {"ids": ids}, None)
