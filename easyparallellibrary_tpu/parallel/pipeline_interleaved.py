@@ -420,7 +420,11 @@ def make_smap_interleaved_grad_fn(feed_fn: Callable,
                   else jax.random.fold_in(rng, (S * K) * M + M + me))
       emit_mb_tree = mb_at(me)
 
-      def do_emit(_):
+      # G threads THROUGH the cond (identity on the skip branch) so no
+      # params-sized zeros tree materializes per tick — same rationale
+      # as the plain 1F1B engine.
+      def do_emit(ops):
+        G_, loss_sum_ = ops
         y_b = jax.lax.psum(
             jnp.where(s_idx == S - 1, Y, jnp.zeros_like(Y)),
             constants.STAGE_AXIS)
@@ -430,15 +434,16 @@ def make_smap_interleaved_grad_fn(feed_fn: Callable,
 
         loss_e, emit_vjp = jax.vjp(emit_wrap, params, y_b)
         dEp, dy_local = emit_vjp((seed / S).astype(loss_e.dtype))
-        return (loss_e.astype(jnp.float32), dEp,
+        G_ = jax.tree_util.tree_map(jnp.add, G_, dEp)
+        return (G_, loss_sum_ + loss_e.astype(jnp.float32),
                 jax.lax.psum(dy_local, constants.STAGE_AXIS))
 
-      def no_emit(_):
-        return jnp.float32(0), zeros_g, jnp.zeros_like(Y)
+      def no_emit(ops):
+        G_, loss_sum_ = ops
+        return G_, loss_sum_, jnp.zeros_like(Y)
 
-      loss_e, dEp, dy = jax.lax.cond(ev, do_emit, no_emit, None)
-      loss_sum = loss_sum + loss_e
-      G = jax.tree_util.tree_map(jnp.add, G, dEp)
+      G, loss_sum, dy = jax.lax.cond(ev, do_emit, no_emit,
+                                     (G, loss_sum))
       CotBuf = buf_write(CotBuf, dy, K - 1, jnp.mod(me, W),
                          ev & (s_idx == S - 1))
 
@@ -482,15 +487,14 @@ def make_smap_interleaved_grad_fn(feed_fn: Callable,
       fb_rng = (None if rng is None
                 else jax.random.fold_in(rng, (S * K) * M + fbm))
 
-      def do_fb(_):
+      def do_fb(G_):
         _, feed_vjp = jax.vjp(
             lambda p: feed_fn(p, mb_at(fbm), fb_rng), params)
         ct_feed = jnp.where(is_fb, dX, jnp.zeros_like(dX))
         (dFp,) = feed_vjp(ct_feed)
-        return dFp
+        return jax.tree_util.tree_map(jnp.add, G_, dFp)
 
-      dFp = jax.lax.cond(row["fb_need"], do_fb, lambda _: zeros_g, None)
-      G = jax.tree_util.tree_map(jnp.add, G, dFp)
+      G = jax.lax.cond(row["fb_need"], do_fb, lambda G_: G_, G)
 
       return (Y, dX, InBuf, Res, CotBuf, G, loss_sum, aux_sum), None
 

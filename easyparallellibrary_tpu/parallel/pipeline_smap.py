@@ -669,7 +669,13 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
                   else jax.random.fold_in(rng, S * M + M + me))
       emit_mb = mb_at(me)
 
-      def do_emit(_):
+      # The grad accumulator G threads THROUGH the cond (operand and
+      # output) so the skip branch is the identity on the carry —
+      # returning a fresh zeros_g tree instead would materialize a
+      # params-sized buffer every tick (measured +0.6 MB temp at the
+      # bench shape).
+      def do_emit(ops):
+        G_, loss_sum_ = ops
         y_b = jax.lax.psum(
             jnp.where(s_idx == S - 1, Y, jnp.zeros_like(Y)),
             constants.STAGE_AXIS)
@@ -683,15 +689,16 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
         # engine's share scaling) — the psum of dy_local below then
         # lands at 1x.
         dEp, dy_local = emit_vjp((seed / S).astype(loss_e.dtype))
-        return (loss_e.astype(jnp.float32), dEp,
+        G_ = jax.tree_util.tree_map(jnp.add, G_, dEp)
+        return (G_, loss_sum_ + loss_e.astype(jnp.float32),
                 jax.lax.psum(dy_local, constants.STAGE_AXIS))
 
-      def no_emit(_):
-        return jnp.float32(0), zeros_g, jnp.zeros_like(Y)
+      def no_emit(ops):
+        G_, loss_sum_ = ops
+        return G_, loss_sum_, jnp.zeros_like(Y)
 
-      loss_e, dEp, dy = jax.lax.cond(valid_e, do_emit, no_emit, None)
-      loss_sum = loss_sum + loss_e
-      G = jax.tree_util.tree_map(jnp.add, G, dEp)
+      G, loss_sum, dy = jax.lax.cond(valid_e, do_emit, no_emit,
+                                     (G, loss_sum))
 
       # ---- backward sub-tick: this stage retires one micro-batch ----
       m_b = t - 2 * (S - 1) + s_idx
@@ -734,16 +741,15 @@ def make_smap_1f1b_grad_fn(feed_fn: Callable,
       fb_rng = (None if rng is None
                 else jax.random.fold_in(rng, S * M + fbc))
 
-      def do_fb(_):
+      def do_fb(G_):
         _, feed_vjp = jax.vjp(
             lambda p: feed_fn(p, mb_at(fbc), fb_rng), params)
         ct_feed = jnp.where((s_idx == 0) & valid_fb, dX,
                             jnp.zeros_like(dX))
         (dFp,) = feed_vjp(ct_feed)
-        return dFp
+        return jax.tree_util.tree_map(jnp.add, G_, dFp)
 
-      dFp = jax.lax.cond(valid_fb, do_fb, lambda _: zeros_g, None)
-      G = jax.tree_util.tree_map(jnp.add, G, dFp)
+      G = jax.lax.cond(valid_fb, do_fb, lambda G_: G_, G)
 
       return (Y, R, dX, G, loss_sum, aux_sum), None
 
