@@ -47,6 +47,11 @@ if [ -f benchmarks/smap_overhead.py ]; then
   run 1800 HW/smap_overhead.json python benchmarks/smap_overhead.py
 fi
 
+echo "--- MoE a2a time share (if present) ---"
+if [ -f benchmarks/moe_a2a_share.py ]; then
+  run 1800 HW/moe_a2a_share.json python benchmarks/moe_a2a_share.py
+fi
+
 echo "--- MFU tuning sweep (VERDICT item 7: toward 0.55) ---"
 timeout 3600 bash benchmarks/mfu_sweep.sh > HW/mfu_sweep.txt 2>&1
 echo "[$(date -u +%FT%TZ)] mfu_sweep rc=$? (HW/mfu_sweep.txt)"

@@ -151,3 +151,68 @@ def test_smap_ring_token_divisibility_raises():
   ids = jnp.zeros((4, 16), jnp.int32)  # 15 tokens % 2 != 0
   with pytest.raises(ValueError, match="seq shards"):
     grad_fn(None, {"ids": ids}, None)
+
+
+def test_smap_ring_seq4_matches_sequential():
+  """Deeper ring (stage2 x seq4, data=1): the wrap masking and the
+  n-step rotation hold beyond the minimal two-device ring."""
+  _check_matches_sequential(dict(stage=2, seq=4), {"attn_impl": "ring"})
+
+
+def test_smap_ring_zero1_trains_and_scatters():
+  """Composition stack: ring sequence parallelism x ZeRO-1 x smap — the
+  seq-manual grad pmean composes with the owner reduce-scatter (seq
+  pmean first, then scatter over data; pipeline_smap._reduce_grads)."""
+  import optax
+  from easyparallellibrary_tpu.models.gpt import make_gpt_train_step
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state, parallelize)
+
+  def run(zero_level):
+    conf = {"pipeline.engine": "smap",
+            "sequence.parallelism": "ring",
+            "sequence.axis_size": 2,
+            "sequence.ring_impl": "dense"}
+    if zero_level:
+      conf["zero.level"] = zero_level
+    env = epl.init(epl.Config(conf))
+    cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+                    d_ff=64, max_seq_len=16, dtype=jnp.float32,
+                    seq_parallel=True, attn_impl="ring",
+                    pipeline_stages=2, num_micro_batch=2)
+    with epl.replicate(1):
+      model = GPT(cfg)
+    mesh = env.cluster.build_mesh(stage=2, seq=2)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 17)),
+                      jnp.int32)
+
+    def init_fn(rng):
+      return TrainState.create(
+          apply_fn=model.apply,
+          params=model.init(rng, ids[:, :-1])["params"],
+          tx=optax.adam(1e-2))
+
+    state, sh = create_sharded_train_state(
+        init_fn, mesh, jax.random.PRNGKey(0), zero_level=zero_level)
+    step = parallelize(make_gpt_train_step(model), mesh, sh)
+    losses = []
+    for i in range(3):
+      state, m = step(state, {"ids": ids}, jax.random.PRNGKey(i))
+      losses.append(float(m["loss"]))
+    if zero_level:
+      txt = step.jitted.lower(state, {"ids": ids},
+                              jax.random.PRNGKey(9)).as_text()
+      assert "reduce-scatter" in txt or "reduce_scatter" in txt
+    return losses
+
+  np.testing.assert_allclose(run("v1"), run(""), rtol=2e-5)
+
+
+def test_smap_interleaved_ring_tp_stack_matches_sequential():
+  """The deepest stack that fits 8 devices: pipeline x interleave-K2 x
+  ring sequence parallelism x tensor parallelism, one engine program
+  (the docs/tutorials.md §5 recipe)."""
+  _check_matches_sequential(dict(stage=2, seq=2, model=2),
+                            {"attn_impl": "ring",
+                             "tensor_parallel": True,
+                             "pipeline_interleave": 2})
