@@ -38,7 +38,12 @@ class BertConfig:
   param_dtype: Any = jnp.float32
   tensor_parallel: bool = False
   remat: bool = False
-  attn_impl: str = "xla"             # xla | pallas_flash (non-causal)
+  # xla | pallas_flash | ring | ulysses (all non-causal).  ring/ulysses
+  # give the encoder family the same long-context scaling as GPT
+  # (sequence sharded over the seq axis; bidirectional rings have no
+  # zigzag — the causal-balance trick is moot without a mask).
+  attn_impl: str = "xla"
+  seq_parallel: bool = False         # shard activations over seq
   pipeline_stages: int = 1
   num_micro_batch: int = 1
   pipeline_schedule: str = ""   # "" = from Config pipeline.strategy
@@ -57,6 +62,11 @@ def bert_large_config(**kw):
 
 
 from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
+
+
+def _act_spec(cfg: BertConfig) -> P:
+  seq = constants.SEQ_AXIS if cfg.seq_parallel else None
+  return P(constants.DATA_AXIS, seq, None)
 
 
 class EncoderBlock(nn.Module):
@@ -83,6 +93,17 @@ class EncoderBlock(nn.Module):
       from easyparallellibrary_tpu.kernels.flash_attention import (
           flash_attention)
       attn = flash_attention(q, k, v, causal=False).reshape(B, S, D)
+    elif cfg.attn_impl == "ring":
+      # Bidirectional ring — the encoder family's long-context path
+      # (sequence sharded over the seq axis; composes with the smap
+      # pipeline engines exactly like GPT's).
+      from easyparallellibrary_tpu.sequence.ring_attention import (
+          ring_attention)
+      attn = ring_attention(q, k, v, causal=False).reshape(B, S, D)
+    elif cfg.attn_impl == "ulysses":
+      from easyparallellibrary_tpu.sequence.ulysses import (
+          ulysses_attention)
+      attn = ulysses_attention(q, k, v, causal=False).reshape(B, S, D)
     elif cfg.attn_impl == "xla":
       scale = 1.0 / jnp.sqrt(D // H).astype(cfg.dtype)
       logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -92,8 +113,8 @@ class EncoderBlock(nn.Module):
     else:
       # A typo'd impl silently falling back to dense attention would
       # mislabel any benchmark run on top of it (same guard as GPT).
-      raise ValueError(f"attn_impl must be 'xla' or 'pallas_flash'; "
-                       f"got {cfg.attn_impl!r}")
+      raise ValueError(f"attn_impl must be 'xla', 'pallas_flash', "
+                       f"'ring' or 'ulysses'; got {cfg.attn_impl!r}")
     x = x + Dense(D, parallel=row, dtype=cfg.dtype,
                   param_dtype=cfg.param_dtype, name="proj")(attn)
 
@@ -102,7 +123,7 @@ class EncoderBlock(nn.Module):
                       param_dtype=cfg.param_dtype, name="wi")(y))
     x = x + Dense(D, parallel=row, dtype=cfg.dtype,
                   param_dtype=cfg.param_dtype, name="wo")(h)
-    return _constrain(x, P(constants.DATA_AXIS, None, None))
+    return _constrain(x, _act_spec(cfg))
 
 
 class BertStage(nn.Module):
@@ -138,7 +159,7 @@ class Bert(nn.Module):
     x = (tok(ids).astype(cfg.dtype) + pos[None, :S].astype(cfg.dtype)
          + seg(type_ids).astype(cfg.dtype))
     x = LayerNorm(dtype=cfg.dtype, name="ln_emb")(x)
-    x = _constrain(x, P(constants.DATA_AXIS, None, None))
+    x = _constrain(x, _act_spec(cfg))
 
     if cfg.pipeline_stages > 1:
       from easyparallellibrary_tpu.parallel.pipeline import Pipeline
@@ -165,6 +186,7 @@ class Bert(nn.Module):
             num_micro_batch=cfg.num_micro_batch,
             sequential=cfg.pipeline_debug_sequential,
             remat_stage=sched.remat_stage or cfg.remat,
+            seq_parallel=cfg.seq_parallel,
             name="pipeline" if K == 1 else f"pipeline_{k}")(x)
     else:
       block_cls = EncoderBlock
@@ -217,7 +239,7 @@ class BertEncoderTrunk(nn.Module):
     x = (tok(ids).astype(cfg.dtype) + pos[None, :S].astype(cfg.dtype)
          + seg(type_ids).astype(cfg.dtype))
     x = LayerNorm(dtype=cfg.dtype, name="ln_emb")(x)
-    x = _constrain(x, P(constants.DATA_AXIS, None, None))
+    x = _constrain(x, _act_spec(cfg))
     block_cls = EncoderBlock
     if cfg.remat:
       block_cls = nn.checkpoint(EncoderBlock, prevent_cse=False)
@@ -263,11 +285,12 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
     emit  = final LayerNorm + tied-table MLM logits slab + sharded CE,
             normalized by THIS micro-batch's mask count.
 
-  Per-micro-batch loss semantics: the engine averages the M per-mb
-  masked means, which equals `bert_mlm_loss`'s global ratio only when
-  mask counts are equal across micro-batches (the standard fixed-count
-  MLM masking); with ragged counts the two differ by the usual
-  mean-of-ratios vs ratio-of-sums gap.
+  Per-micro-batch loss semantics: each micro-batch's masked loss is the
+  ratio-of-sums across ALL its shards (data rows and, under sequence
+  parallelism, token shards — ragged per-shard mask counts are exact);
+  the engine then averages the M per-micro-batch ratios, which equals
+  `bert_mlm_loss`'s whole-batch ratio when mask counts are equal across
+  micro-batches (the standard fixed-count MLM masking).
 
   ``pipeline_interleave`` K > 1 upgrades ``schedule="1f1b"`` to the
   Megatron-interleaved table-driven engine, exactly as the GPT wiring
@@ -281,10 +304,11 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
   """
   from easyparallellibrary_tpu.env import Env
   from easyparallellibrary_tpu.parallel.pipeline_smap import (
-      MANUAL_AXES, check_unpadded_vocab, engine_meta_specs,
+      check_seq_token_count, check_unpadded_vocab, engine_meta_specs,
       make_engine_tree_fns, make_smap_1f1b_grad_fn,
       make_smap_gpipe_grad_fn, rebox_grads, run_smap_engine,
-      sharded_softmax_ce, stage_stacked_specs, vocab_partial_embed,
+      seq_engine_axes, seq_manual_mode, sharded_softmax_ce,
+      stage_stacked_specs, token_offset_slice, vocab_partial_embed,
       zero1_grad_layout)
   from easyparallellibrary_tpu.parallel.schedule_1f1b import (
       split_micro_batches)
@@ -295,6 +319,14 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
   K = max(1, cfg.pipeline_interleave)
   if S <= 1:
     raise ValueError("smap pipeline needs pipeline_stages > 1")
+  # Sequence parallelism composes exactly as in the GPT wiring (shared
+  # helpers, parallel/pipeline_smap.py): the engine goes manual over
+  # seq, runs stage compute branch-uniformly, tokens shard over seq,
+  # and the masked-LM emit ratio psums its numerator/denominator over
+  # the token shards (ratio-of-sums — the same per-micro-batch
+  # semantics and div0 clamp as the unsharded path even with ragged
+  # per-shard mask counts).
+  seq_size, seq_manual = seq_manual_mode(cfg.attn_impl, cfg.num_heads)
   if schedule == "1f1b" and K > 1:
     schedule = "interleaved"
   if schedule == "interleaved" and K < 2:
@@ -328,7 +360,8 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
     type_ids = mb.get("type_ids", jnp.zeros_like(ids))
     x = jax.lax.psum(vocab_partial_embed(p["wte"]["embedding"], ids),
                      constants.STAGE_AXIS).astype(cfg.dtype)
-    x = x + p["wpe"][None, :ids.shape[1]].astype(cfg.dtype)
+    pe = token_offset_slice(p["wpe"], ids.shape[1], seq_manual)
+    x = x + pe[None].astype(cfg.dtype)
     x = x + jnp.take(p["wse"]["embedding"], type_ids,
                      axis=0).astype(cfg.dtype)
     return ln_emb.apply({"params": p["ln_emb"]}, x)
@@ -369,7 +402,23 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
         lambda hh: jnp.zeros(hh.shape[:-1] + (w.shape[0],), hh.dtype), h)
     ce = sharded_softmax_ce(ll, mb["labels"])
     mask = mb["mask"].astype(jnp.float32)
-    return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    num = jnp.sum(ce * mask)
+    den = jnp.sum(mask)
+    # Ratio-of-sums across ALL shards of the micro-batch (data rows +,
+    # under seq-manual, token shards): PSUM both sides so the ratio and
+    # its div0 clamp see the true micro-batch totals — per-shard ratios
+    # would weight shards equally regardless of their mask counts, and
+    # a pmean'd denominator would silently engage the clamp on sparse
+    # masks (review finding: 2x/4x loss shrink).  Gradient calibration:
+    # the psum transposes overcount by the shard count, and the
+    # engines' final grad pmean over exactly those axes
+    # (grad_mean_axes) divides it back out — the same cancellation as
+    # the GPT emit's pmean form.
+    red = ((constants.DATA_AXIS, constants.SEQ_AXIS) if seq_manual
+           else (constants.DATA_AXIS,))
+    num = jax.lax.psum(num, red)
+    den = jax.lax.psum(den, red)
+    return num / jnp.maximum(den, 1.0)
 
   engine_cache = {}
   # Shared K-pass stacking convention with the GPT wiring.
@@ -385,10 +434,13 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
       zero1_dp = 0
 
   def grad_fn(params, batch, rng, loss_scale=None):
+    check_seq_token_count(batch["ids"].shape[1], seq_size, seq_manual)
     un = to_engine_tree(nn.meta.unbox(params))
     if "fn" not in engine_cache:
       specs = stage_stacked_specs(un)
       specs["wte"]["embedding"] = P(constants.STAGE_AXIS, None)
+      manual, bspec = seq_engine_axes(seq_manual)
+      uniform = seq_manual or None
       zero1 = None
       if zero1_dp:
         dims, gspecs = zero1_grad_layout(
@@ -399,13 +451,15 @@ def make_bert_smap_grad_fn(model: Bert, mesh=None, schedule: str = "1f1b"):
             make_smap_interleaved_grad_fn)
         engine_cache["fn"] = make_smap_interleaved_grad_fn(
             feed_fn, stage_fn, emit_fn, S, K, M, mesh, specs,
-            manual_axes=MANUAL_AXES, zero1=zero1)
+            batch_spec=bspec, manual_axes=manual,
+            uniform_compute=uniform, zero1=zero1)
       else:
         build = (make_smap_1f1b_grad_fn if schedule == "1f1b"
                  else make_smap_gpipe_grad_fn)
         engine_cache["fn"] = build(
             feed_fn, stage_fn, emit_fn, S, M, mesh, specs,
-            manual_axes=MANUAL_AXES, zero1=zero1)
+            batch_spec=bspec, manual_axes=manual,
+            uniform_compute=uniform, zero1=zero1)
     mbs = split_micro_batches(
         {k: v for k, v in batch.items()
          if k in ("ids", "labels", "mask", "type_ids")}, M)

@@ -729,10 +729,11 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
   """
   from easyparallellibrary_tpu.env import Env
   from easyparallellibrary_tpu.parallel.pipeline_smap import (
-      MANUAL_AXES, check_unpadded_vocab, engine_meta_specs,
+      check_seq_token_count, check_unpadded_vocab, engine_meta_specs,
       make_engine_tree_fns, make_smap_1f1b_grad_fn,
       make_smap_gpipe_grad_fn, rebox_grads, run_smap_engine,
-      sharded_softmax_ce, stage_stacked_specs, vocab_partial_embed,
+      seq_engine_axes, seq_manual_mode, sharded_softmax_ce,
+      stage_stacked_specs, token_offset_slice, vocab_partial_embed,
       zero1_grad_layout)
   from easyparallellibrary_tpu.parallel.schedule_1f1b import (
       split_micro_batches)
@@ -753,40 +754,23 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
         "pipeline_interleave > 1 on the smap engine requires the "
         "interleaved-1F1B schedule (pipeline.strategy PreferBackward*); "
         "GPipe order does not interleave chunks")
-  seq_size = 1
-  try:
-    seq_size = Env.get().cluster.axis_size(constants.SEQ_AXIS)
-  except Exception:
-    pass
-  seq_manual = cfg.attn_impl in ("ring", "ulysses") and seq_size > 1
-  if seq_manual:
-    # Sequence parallelism composes by making the engine manual over
-    # the seq axis too: the attention's seq collectives (ring ppermutes
-    # / Ulysses all-to-alls) then ride the AMBIENT region — no nested
-    # shard_map, whose lowered channels span all devices (the round-4
-    # deadlock).  Because XLA gives per-replica-group rendezvous only
-    # to all-reduce (collective-permute/all-to-all are single whole-
-    # mesh channels), the engines additionally run stage compute
-    # branch-UNIFORMLY in this mode (pipeline_smap.
-    # uniform_stage_compute): the collectives execute every tick on
-    # every device, restoring the vmapped engines' uniform-work
-    # semantics for exactly this composition.  Tokens shard over seq
-    # like batch elements over data: micro-batches arrive seq-split,
-    # wpe is sliced at the device's global token offset, the emit CE
-    # pmeans its local-token mean over seq, and the engines pmean
-    # grads over seq (pipeline_smap.grad_mean_axes).
-    if cfg.attn_impl == "ring":
-      ring_impl = Env.get().config.sequence.ring_impl
-      if ring_impl not in ("flash", "dense"):
-        raise ValueError(
-            f"sequence.ring_impl={ring_impl!r} cannot run inside the "
-            "smap engine's seq-manual region (the einsum ring is a "
-            "global-array GSPMD program); use ring_impl='flash' or "
-            "'dense', or a vmapped engine (pipeline.engine='')")
-    elif cfg.num_heads % seq_size:
-      raise ValueError(
-          f"Ulysses on the smap engine requires num_heads "
-          f"({cfg.num_heads}) divisible by the seq axis ({seq_size})")
+  # Sequence parallelism composes by making the engine manual over the
+  # seq axis too: the attention's seq collectives (ring ppermutes /
+  # Ulysses all-to-alls) then ride the AMBIENT region — no nested
+  # shard_map, whose lowered channels span all devices (the round-4
+  # deadlock).  Because XLA gives per-replica-group rendezvous only to
+  # all-reduce (collective-permute/all-to-all are single whole-mesh
+  # channels), the engines additionally run stage compute
+  # branch-UNIFORMLY in this mode (pipeline_smap.uniform_stage_compute):
+  # the collectives execute every tick on every device, restoring the
+  # vmapped engines' uniform-work semantics for exactly this
+  # composition.  Tokens shard over seq like batch elements over data:
+  # micro-batches arrive seq-split, wpe is sliced at the device's
+  # global token offset, the emit CE pmeans its local-token mean over
+  # seq, and the engines pmean grads over seq
+  # (pipeline_smap.grad_mean_axes).  Shared helpers with the BERT
+  # wiring (seq_manual_mode & co) so the guards cannot drift.
+  seq_size, seq_manual = seq_manual_mode(cfg.attn_impl, cfg.num_heads)
   a2a_moe = False
   if cfg.num_experts > 0:
     if cfg.moe_impl == "a2a":
@@ -825,14 +809,7 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
     ids = mb["inputs"]
     x = jax.lax.psum(vocab_partial_embed(p["wte"]["embedding"], ids),
                      constants.STAGE_AXIS)
-    if seq_manual:
-      # ids are this device's token shard; wpe stays replicated and is
-      # sliced at the device's global token offset.
-      t_loc = ids.shape[1]
-      off = jax.lax.axis_index(constants.SEQ_AXIS) * t_loc
-      pe = jax.lax.dynamic_slice_in_dim(p["wpe"], off, t_loc, 0)
-    else:
-      pe = p["wpe"][:ids.shape[1]]
+    pe = token_offset_slice(p["wpe"], ids.shape[1], seq_manual)
     return x.astype(cfg.dtype) + pe[None].astype(cfg.dtype)
 
   def stage_fn(p, x, rng, chunk=None):
@@ -952,11 +929,8 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
 
 
   def grad_fn(params, batch, rng, loss_scale=None):
-    if seq_manual and (batch["ids"].shape[1] - 1) % seq_size:
-      raise ValueError(
-          f"token count {batch['ids'].shape[1] - 1} must divide into "
-          f"{seq_size} seq shards for sequence parallelism on the "
-          "smap engine")
+    check_seq_token_count(batch["ids"].shape[1] - 1, seq_size,
+                          seq_manual)
     un = to_engine_tree(nn.meta.unbox(params))
     if "fn" not in engine_cache:
       # Manual (stage/data) projection only: model-axis TP shardings ride
@@ -966,10 +940,7 @@ def make_gpt_smap_grad_fn(model: GPT, mesh=None, schedule: str = "1f1b"):
       specs["wte"]["embedding"] = P(constants.STAGE_AXIS, None)
       if not cfg.tie_embeddings:
         specs["lm_head"]["kernel"] = P(None, constants.STAGE_AXIS)
-      manual = (MANUAL_AXES | {constants.SEQ_AXIS} if seq_manual
-                else MANUAL_AXES)
-      bspec = (P(None, constants.DATA_AXIS, constants.SEQ_AXIS)
-               if seq_manual else None)
+      manual, bspec = seq_engine_axes(seq_manual)
       uniform = (seq_manual or a2a_moe) or None
       aux_w = cfg.moe_aux_weight if cfg.num_experts > 0 else 0.0
       zero1 = None

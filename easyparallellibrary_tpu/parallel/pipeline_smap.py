@@ -313,6 +313,61 @@ def zero1_grad_layout(un_engine, full_specs_engine, manual_specs, dp):
   return dims, out_specs
 
 
+def seq_manual_mode(attn_impl: str, num_heads: int):
+  """(seq_size, seq_manual) for a model wiring's sequence-parallel
+  composition, with the shared validations: the einsum ring is a
+  global-array program (cannot run on the seq-manual engine's local
+  shards) and Ulysses needs head divisibility.  One helper for the GPT
+  and BERT wirings so the guards cannot drift."""
+  from easyparallellibrary_tpu.env import Env
+  seq_size = 1
+  try:
+    seq_size = Env.get().cluster.axis_size(constants.SEQ_AXIS)
+  except Exception:
+    pass
+  seq_manual = attn_impl in ("ring", "ulysses") and seq_size > 1
+  if seq_manual:
+    if attn_impl == "ring":
+      ring_impl = Env.get().config.sequence.ring_impl
+      if ring_impl not in ("flash", "dense"):
+        raise ValueError(
+            f"sequence.ring_impl={ring_impl!r} cannot run inside the "
+            "smap engine's seq-manual region (the einsum ring is a "
+            "global-array GSPMD program); use ring_impl='flash' or "
+            "'dense', or a vmapped engine (pipeline.engine='')")
+    elif num_heads % seq_size:
+      raise ValueError(
+          f"Ulysses on the smap engine requires num_heads "
+          f"({num_heads}) divisible by the seq axis ({seq_size})")
+  return seq_size, seq_manual
+
+
+def seq_engine_axes(seq_manual: bool):
+  """(manual_axes, batch_spec) for the engines under the wirings'
+  seq-manual mode: tokens shard over seq like batch rows over data."""
+  if seq_manual:
+    return (MANUAL_AXES | {constants.SEQ_AXIS},
+            P(None, constants.DATA_AXIS, constants.SEQ_AXIS))
+  return MANUAL_AXES, None
+
+
+def token_offset_slice(table, t_loc: int, seq_manual: bool):
+  """Rows of a replicated position table for this device's token shard
+  (global offset = seq_rank * t_loc); the plain prefix otherwise."""
+  if seq_manual:
+    off = jax.lax.axis_index(constants.SEQ_AXIS) * t_loc
+    return jax.lax.dynamic_slice_in_dim(table, off, t_loc, 0)
+  return table[:t_loc]
+
+
+def check_seq_token_count(n_tokens: int, seq_size: int,
+                          seq_manual: bool) -> None:
+  if seq_manual and n_tokens % seq_size:
+    raise ValueError(
+        f"token count {n_tokens} must divide into {seq_size} seq "
+        "shards for sequence parallelism on the smap engine")
+
+
 def uniform_stage_compute(manual_axes) -> bool:
   """True when stage compute must run branch-UNIFORMLY (select, not
   lax.cond): the seq-manual engines (ring sequence parallelism) carry
