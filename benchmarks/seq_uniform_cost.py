@@ -1,0 +1,96 @@
+"""Cost of branch-uniform stage compute under smap x sequence
+parallelism (round 5).
+
+The seq-manual engines give up the real-branch ramp FLOP skip
+(pipeline_smap.uniform_stage_compute): collective-permute channels span
+the whole mesh, so ramp ticks must execute the stage function even when
+their output is masked — the same uniform-work semantics the vmapped
+engines always had.  This quantifies what that trade costs and what the
+engine still wins: compiled FLOPs / temp / argument bytes of
+smap-1F1B x ring (uniform) vs the vmapped 1F1B x ring and, as the
+real-branch reference point, smap-1F1B x xla attention (no seq axis,
+real branches) — all at one shape on the 8-device CPU mesh.
+
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import easyparallellibrary_tpu as epl  # noqa: E402
+from easyparallellibrary_tpu.models import GPT, GPTConfig  # noqa: E402
+from easyparallellibrary_tpu.models.gpt import (  # noqa: E402
+    make_gpt_1f1b_grad_fn, make_gpt_smap_grad_fn)
+
+
+def _stats(fn, params, ids):
+  compiled = jax.jit(
+      lambda p: fn(p, {"ids": ids}, None)).lower(params).compile()
+  cost = compiled.cost_analysis() or {}
+  mem = compiled.memory_analysis()
+  return {"gflops": round(float(cost.get("flops", 0.0)) / 1e9, 4),
+          "temp_mb": round(mem.temp_size_in_bytes / 2**20, 2),
+          "arg_mb": round(mem.argument_size_in_bytes / 2**20, 2)}
+
+
+def main():
+  out = {"metric": "smap_seq_uniform_compute_cost",
+         "unit": "compiled per-device program stats",
+         "method": "XLA cost/memory analysis on the 8-device CPU mesh "
+                   "(stage4 x seq2; dense ring blocks)"}
+  S_stages, M = 4, 8
+  base = dict(vocab_size=512, num_layers=8, num_heads=4, d_model=64,
+              d_ff=256, max_seq_len=32, dtype=jnp.float32,
+              pipeline_stages=S_stages, num_micro_batch=M)
+
+  # smap x ring (uniform compute) vs vmapped 1F1B x ring.
+  env = epl.init(epl.Config({"sequence.parallelism": "ring",
+                             "sequence.axis_size": 2,
+                             "sequence.ring_impl": "dense"}))
+  mesh = env.cluster.build_mesh(stage=S_stages, seq=2)
+  cfg = GPTConfig(**base, seq_parallel=True, attn_impl="ring")
+  model = GPT(cfg)
+  dp = mesh.devices.shape[list(mesh.axis_names).index("data")]
+  ids = jnp.asarray(np.random.RandomState(0).randint(
+      0, cfg.vocab_size, (M * dp, cfg.max_seq_len + 1)), jnp.int32)
+  params = model.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+  out["smap_1f1b_ring_uniform"] = _stats(
+      make_gpt_smap_grad_fn(model, mesh), params, ids)
+  out["vmap_1f1b_ring"] = _stats(make_gpt_1f1b_grad_fn(model),
+                                 params, ids)
+
+  # Real-branch reference point: same shape, xla attention, no seq axis.
+  env = epl.init()
+  mesh2 = env.cluster.build_mesh(stage=S_stages)
+  cfg2 = GPTConfig(**base, attn_impl="xla")
+  model2 = GPT(cfg2)
+  dp2 = mesh2.devices.shape[list(mesh2.axis_names).index("data")]
+  ids2 = jnp.asarray(np.random.RandomState(0).randint(
+      0, cfg2.vocab_size, (M * dp2, cfg2.max_seq_len + 1)), jnp.int32)
+  params2 = model2.init(jax.random.PRNGKey(0), ids2[:, :-1])["params"]
+  out["smap_1f1b_xla_real_branches"] = _stats(
+      make_gpt_smap_grad_fn(model2, mesh2), params2, ids2)
+  out["vmap_1f1b_xla"] = _stats(make_gpt_1f1b_grad_fn(model2),
+                                params2, ids2)
+
+  u = out["smap_1f1b_ring_uniform"]["gflops"]
+  v = out["vmap_1f1b_ring"]["gflops"]
+  out["uniform_vs_vmap_flops_ratio"] = round(u / max(v, 1e-9), 4)
+  print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+  main()

@@ -271,3 +271,47 @@ def test_explicit_zero1_probe_catches_adafactor_at_current_default():
   params = {"w": jnp.ones((4, 4))}
   with pytest.raises(ValueError, match="elementwise"):
     _assert_elementwise_tx(optax.adafactor(1e-3), params)
+
+
+def test_zero_v1_smap_interleaved_and_tp_match_baseline():
+  """ZeRO-1 composes with the interleaved schedule (K-stacked leaves:
+  the owner dim maps +1 past the chunk axis) and with TP (meta-sharded
+  model dims are skipped by the owner-dim choice)."""
+  from easyparallellibrary_tpu.models import GPT, GPTConfig
+  from easyparallellibrary_tpu.models.gpt import make_gpt_train_step
+
+  def run(zero_level):
+    conf = {"pipeline.engine": "smap"}
+    if zero_level:
+      conf["zero.level"] = zero_level
+    env = epl.init(epl.Config(conf))
+    cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+                    d_ff=64, max_seq_len=16, dtype=jnp.float32,
+                    pipeline_stages=2, num_micro_batch=2,
+                    pipeline_interleave=2, tensor_parallel=True)
+    with epl.replicate(1):
+      model = GPT(cfg)
+    mesh = env.cluster.build_mesh(stage=2, model=2)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 17)),
+                      jnp.int32)
+
+    def init_fn(rng):
+      return TrainState.create(
+          apply_fn=model.apply,
+          params=model.init(rng, ids[:, :-1])["params"],
+          tx=optax.adam(1e-2))
+
+    state, sh = create_sharded_train_state(
+        init_fn, mesh, jax.random.PRNGKey(0), zero_level=zero_level)
+    step = parallelize(make_gpt_train_step(model), mesh, sh)
+    losses = []
+    for i in range(3):
+      state, m = step(state, {"ids": ids}, jax.random.PRNGKey(i))
+      losses.append(float(m["loss"]))
+    if zero_level:
+      txt = step.jitted.lower(state, {"ids": ids},
+                              jax.random.PRNGKey(9)).as_text()
+      assert "reduce-scatter" in txt or "reduce_scatter" in txt
+    return losses
+
+  np.testing.assert_allclose(run("v1"), run(""), rtol=2e-5)
