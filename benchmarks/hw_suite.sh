@@ -53,7 +53,7 @@ if [ -f benchmarks/moe_a2a_share.py ]; then
 fi
 
 echo "--- MFU tuning sweep (VERDICT item 7: toward 0.55) ---"
-timeout 3600 bash benchmarks/mfu_sweep.sh > HW/mfu_sweep.txt 2>&1
+timeout 5400 bash benchmarks/mfu_sweep.sh > HW/mfu_sweep.txt 2>&1
 echo "[$(date -u +%FT%TZ)] mfu_sweep rc=$? (HW/mfu_sweep.txt)"
 
 echo "=== hw_suite done $(date -u +%FT%TZ) ==="

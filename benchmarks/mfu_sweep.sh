@@ -25,3 +25,6 @@ run "batch20_default"      EPL_BENCH_BATCH=20
 run "remat_nothing"        EPL_BENCH_REMAT=nothing EPL_BENCH_BATCH=16,12,8
 run "losschunk512_b16"     EPL_BENCH_LOSS_CHUNK=512 EPL_BENCH_BATCH=16
 run "losschunk128_b16"     EPL_BENCH_LOSS_CHUNK=128 EPL_BENCH_BATCH=16
+run "batch32_fallback"     EPL_BENCH_BATCH=32,28,24
+run "attn_xla_b16"         EPL_BENCH_ATTN=xla EPL_BENCH_BATCH=16,12
+run "nothing_chunk512"     EPL_BENCH_REMAT=nothing EPL_BENCH_LOSS_CHUNK=512 EPL_BENCH_BATCH=16,12,8

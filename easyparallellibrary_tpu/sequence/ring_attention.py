@@ -522,6 +522,12 @@ def _ring_manual(q, k, v, causal: bool):
   devices regardless of the outer grouping.)  Requires ring_impl
   "flash"/"dense" — the einsum ring is a global-array GSPMD program and
   cannot run on local shards.
+
+  TP caveat: under tensor parallelism the head dim rides the AUTO model
+  axis, and XLA cannot partition a pallas custom call over an auto
+  axis — with ring_impl="flash" GSPMD will all-gather the heads around
+  each block kernel.  Use ring_impl="dense" for TP x ring x smap (the
+  XLA block einsums partition cleanly), or keep flash when TP is off.
   """
   env = Env.get()
   n = env.cluster.axis_size(constants.SEQ_AXIS)
