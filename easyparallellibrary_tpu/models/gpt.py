@@ -1071,9 +1071,18 @@ def make_gpt_train_step(model: GPT, config=None):
       return build_train_step(
           grad_fn=make_gpt_smap_grad_fn(model, schedule=schedule),
           config=conf, num_apply_group=groups)
+    from easyparallellibrary_tpu.utils.logging import get_logger
+    if cfg.vocab_size % cfg.pipeline_stages == 0:
+      # Only advise 'smap' when this config actually satisfies its
+      # constraints.
+      get_logger().info(
+          "pipeline.engine=%r runs the lockstep vmapped engine; the "
+          "per-device shard_map engine (pipeline.engine='smap') "
+          "measured lower compiled FLOPs, smaller temps and "
+          "stage-resident argument bytes at every attested composition "
+          "(BASELINE.md round-5 tables).", conf.pipeline.engine)
     use_1f1b = sched.remat_stage  # PreferBackward / PreferBackwardOptimizer
     if use_1f1b and cfg.pipeline_interleave > 1:
-      from easyparallellibrary_tpu.utils.logging import get_logger
       get_logger().warning(
           "pipeline.strategy=%s requests 1F1B but pipeline_interleave=%d "
           "is only interleaved on the shard_map engine "

@@ -175,3 +175,57 @@ def test_schedule_buffer_replay_no_collisions(S, K, M):
         j, m = int(sch.b_chunk[t, d]), int(sch.b_mb[t, d])
         assert cot.get((j, m % W)) == m, (d, t, j, m)
         assert res.get((j, m % W)) == m, (d, t, j, m)
+
+
+@pytest.mark.parametrize("S,K,M", [(2, 2, 2), (2, 3, 5), (3, 2, 7),
+                                   (4, 2, 8), (4, 4, 6), (8, 2, 8)])
+def test_interleaved_schedule_properties(S, K, M):
+  """Host-side invariants of the list scheduler across an (S, K, M)
+  grid: every (virtual stage, micro-batch) op runs exactly once in each
+  direction, emits cover every micro-batch exactly once, the tick-global
+  feed/fb tables agree with device 0's chunk-0 slots, and the buffer
+  depth covers the in-flight window."""
+  from easyparallellibrary_tpu.parallel.pipeline_interleaved import (
+      build_interleaved_schedule)
+
+  sched = build_interleaved_schedule(S, K, M)
+  V = S * K
+  # Each op exactly once per direction.
+  assert int(sched.f_valid.sum()) == V * M
+  assert int(sched.b_valid.sum()) == V * M
+  for valid, chunk, mb in ((sched.f_valid, sched.f_chunk, sched.f_mb),
+                           (sched.b_valid, sched.b_chunk, sched.b_mb)):
+    seen = set()
+    for t in range(sched.T):
+      for d in range(S):
+        if valid[t, d]:
+          key = (int(chunk[t, d]) * S + d, int(mb[t, d]))
+          assert key not in seen
+          seen.add(key)
+    assert len(seen) == V * M
+  # Emits: every micro-batch exactly once.
+  assert int(sched.emit_valid.sum()) == M
+  assert sorted(sched.emit_mb[sched.emit_valid].tolist()) == list(range(M))
+  # Tick-global feed table matches device 0's chunk-0 forward slots.
+  for t in range(sched.T):
+    if sched.f_valid[t, 0] and sched.f_chunk[t, 0] == 0:
+      assert sched.feed_mb[t] == sched.f_mb[t, 0]
+    if sched.b_valid[t, 0] and sched.b_chunk[t, 0] == 0:
+      assert sched.fb_mb[t] == sched.b_mb[t, 0]
+  # Buffer-slot collision freedom: replay the residual writes/reads —
+  # a forward's (device, chunk, mb % W) slot must not be overwritten
+  # by a later forward before its own backward reads it.
+  open_slots = {}
+  for t in range(sched.T):
+    for d in range(S):
+      if sched.f_valid[t, d]:
+        key = (d, int(sched.f_chunk[t, d]),
+               int(sched.f_mb[t, d]) % sched.W)
+        assert key not in open_slots, (key, t)
+        open_slots[key] = int(sched.f_mb[t, d])
+      if sched.b_valid[t, d]:
+        key = (d, int(sched.b_chunk[t, d]),
+               int(sched.b_mb[t, d]) % sched.W)
+        assert open_slots.get(key) == int(sched.b_mb[t, d]), (key, t)
+        del open_slots[key]
+  assert not open_slots
