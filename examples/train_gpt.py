@@ -11,6 +11,9 @@ Usage (single host; add `epl-tpu-launch` for multi-host):
   python examples/train_gpt.py --pp 2 --micro 8 --engine smap
   python examples/train_gpt.py --pp 2 --micro 8 --engine smap \
       --interleave 2 --layers 8                      # interleaved 1F1B
+  python examples/train_gpt.py --pp 2 --micro 8 --engine smap \
+      --seq ring --seq-size 2 --tp 2 --interleave 2 --zero v1 \
+      --layers 8        # the full round-5 composition stack, one engine
 
 (reference analog: the FastNN GPT recipes driven by epl.replicate/split,
 /root/reference/README.md:40-70)
