@@ -455,23 +455,28 @@ def make_smap_gpipe_grad_fn(feed_fn: Callable,
 
     feed_fn(p_loc, mb, rng) -> x
         Embedding/pre-stage.  MUST reconstruct the full activation via
-        psum over the stage axis (see `vocab_partial_embed`); runs every
-        tick on every device (cheap gather + one psum); only stage 0's
-        result is consumed.
+        psum over the stage axis (see `vocab_partial_embed`); the
+        engine evaluates it on every DEVICE but only on the ticks that
+        feed (tick-global gate t < M — uniform branch, so the psum
+        stays rendezvous-safe); only stage 0's result is consumed.
     stage_fn(p_loc, x, rng) -> (y, aux_scalar)
         ONE stage, shape-preserving.  Gated by the engine inside
-        lax.cond — bubble ticks never execute it.  Must contain no
-        stage-axis collectives.  `aux_scalar` is a differentiable
-        per-(stage, micro-batch) auxiliary loss (e.g. MoE load
-        balancing; 0.0 when unused) weighted into the objective by
-        `stage_aux_weight` — it is LOCAL to the owning device (unlike
-        the emit loss, which is collective), so the engine psums its
-        total over the stage axis for reporting.
+        lax.cond — bubble ticks never execute it (except in the
+        branch-uniform modes, see `uniform_stage_compute`).  Must
+        contain no stage-axis collectives.  `aux_scalar` is a
+        differentiable per-(stage, micro-batch) auxiliary loss (e.g.
+        MoE load balancing; 0.0 when unused) weighted into the
+        objective by `stage_aux_weight` — it is LOCAL to the owning
+        device (unlike the emit loss, which is collective), so the
+        engine psums its total over the stage axis for reporting.
     emit_fn(p_loc, y, mb, valid, rng) -> scalar loss (float32)
         Head + loss for the micro-batch leaving the last stage; `y` is
-        the psum-broadcast last-stage output.  Collective over the stage
-        axis (see `sharded_softmax_ce`); gate the heavy local matmul on
-        `valid` with lax.cond, keep the collectives unconditional.
+        the psum-broadcast last-stage output.  Collective over the
+        stage axis (see `sharded_softmax_ce`).  The engine gates the
+        WHOLE evaluation on the tick-global emit validity (uniform
+        branch — its collectives execute only on the M emitting
+        ticks); inside it, still gate the heavy local matmul on
+        `valid` with lax.cond so masked evaluations skip the slab.
 
   Returns ``grad_fn(params, mbs, rng) -> ((loss, metrics), grads)`` over
   GLOBAL arrays: params laid out per `param_specs`, `mbs` micro-batched
