@@ -216,3 +216,31 @@ def test_smap_interleaved_ring_tp_stack_matches_sequential():
                             {"attn_impl": "ring",
                              "tensor_parallel": True,
                              "pipeline_interleave": 2})
+
+
+def test_smap_ring_loss_scale_invariant():
+  """AMP x sequence parallelism: the engine's backward seeded with a
+  loss scale returns UNSCALED grads identical to the unscaled run —
+  the seq-axis pmean calibration is linear in the seed."""
+  env = epl.init(epl.Config({"sequence.ring_impl": "dense"}))
+  mesh = env.cluster.build_mesh(stage=2, seq=2)
+  cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+                  d_ff=64, max_seq_len=16, dtype=jnp.float32,
+                  seq_parallel=True, attn_impl="ring",
+                  pipeline_stages=2, num_micro_batch=2)
+  pp = GPT(cfg)
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 17)),
+                    jnp.int32)
+  params = pp.init(jax.random.PRNGKey(0), ids[:, :-1])["params"]
+  grad_fn = make_gpt_smap_grad_fn(pp, mesh)
+  (l1, _), g1 = jax.jit(
+      lambda p: grad_fn(p, {"ids": ids}, None))(params)
+  (l2, _), g2 = jax.jit(
+      lambda p: grad_fn(p, {"ids": ids}, None, 256.0))(params)
+  np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(
+          np.asarray(a.value if hasattr(a, "value") else a),
+          np.asarray(b.value if hasattr(b, "value") else b),
+          rtol=1e-4, atol=1e-6),
+      g1, g2)
