@@ -92,18 +92,21 @@ def _fallback_report(reason: str) -> None:
   BENCH_EVIDENCE.json) rather than an unverifiable 0.0/prose number."""
   rec = bench_evidence.latest_record(METRIC)
   if rec is None:
-    _report({"metric": METRIC, "value": 0.0, "unit": "mfu",
-             "vs_baseline": 0.0,
+    _report({"metric": METRIC, "value": None, "unit": "mfu",
+             "vs_baseline": None,
              "detail": {"error": reason + "; no evidence records exist"}})
     return
   _report({
       "metric": METRIC,
-      "value": rec["value"],
+      # A stale number must be UNQUOTABLE as a fresh one: the headline
+      # value is null, the carried-forward measurement lives under
+      # `last_known` (VERDICT weak #6 — `stale: True` next to a real
+      #-looking value still got quoted as a fresh capture).
+      "value": None,
+      "last_known": rec["value"],
       "unit": rec.get("unit", "mfu"),
-      "vs_baseline": round(rec["value"] / 0.40, 4),
-      # Top-level staleness marker: consumers comparing round-over-round
-      # numbers must not mistake a carried-forward measurement for a
-      # fresh one (detail.fallback alone was too easy to miss).
+      "vs_baseline": None,
+      "last_known_vs_baseline": round(rec["value"] / 0.40, 4),
       "stale": True,
       "detail": {
           "fallback": "evidence",

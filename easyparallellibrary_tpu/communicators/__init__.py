@@ -3,11 +3,17 @@ from easyparallellibrary_tpu.communicators.collectives import (
     ppermute, reduce, reduce_scatter, ring_shift,
 )
 from easyparallellibrary_tpu.communicators.fusion import (
-    FusionPlan, batch_all_reduce, build_fusion_plan,
+    FusionPlan, batch_all_reduce, batch_reduce_scatter, build_fusion_plan,
+)
+from easyparallellibrary_tpu.communicators.overlap import (
+    all_gather_matmul, matmul_reduce_scatter, resolve_num_chunks, ring_step,
 )
 
 __all__ = [
     "all_reduce", "all_gather", "reduce_scatter", "all_to_all", "broadcast",
     "reduce", "ppermute", "ring_shift", "axis_index", "axis_size",
     "FusionPlan", "build_fusion_plan", "batch_all_reduce",
+    "batch_reduce_scatter",
+    "all_gather_matmul", "matmul_reduce_scatter", "resolve_num_chunks",
+    "ring_step",
 ]

@@ -170,3 +170,68 @@ def batch_all_reduce(tree,
       wire = wire.astype(orig_dtype) / compress_scale
     reduced.append(wire)
   return plan.unflatten(reduced)
+
+
+def batch_reduce_scatter(tree,
+                         axis_name: str,
+                         dims,
+                         num_shards: int,
+                         num_chunks: int = 0,
+                         fusion_threshold_mb: int = 32,
+                         max_splits: int = 60):
+  """Bucketed reduce-to-owner for a gradient pytree inside a shard_map
+  region — the ZeRO-1 twin of :func:`batch_all_reduce`, sharing its
+  bucketing (and, through ``num_chunks``, the latency-hiding ring plans
+  of ``communicators/overlap.py``).
+
+  ``dims``: a pytree matching ``tree`` whose int leaves name the
+  dimension each gradient is reduce-scattered over (``-1`` = leaf passes
+  through untouched — the caller keeps its pmean path for those).  Every
+  scattered leaf is viewed as ``[num_shards, block]`` (its owner dim
+  moved to the front), bucketed by dtype/size exactly like
+  :func:`build_fusion_plan`, concatenated into one ``[num_shards, B]``
+  buffer per bucket, and reduce-scattered with ONE collective per bucket
+  — ring-decomposed into ``num_chunks`` chunks when >= 2 (successive
+  buckets' rings pipeline against each other's adds), the fused
+  ``psum_scatter`` otherwise.  Per-leaf results equal the per-leaf
+  ``psum_scatter`` (same blocks, same summands).
+
+  Returns the tree with scattered leaves replaced by their owner shards
+  (NOT yet divided for a mean — callers own that, as in
+  ``pipeline_smap._reduce_grads``).
+  """
+  from easyparallellibrary_tpu.communicators import overlap
+  leaves, treedef = jax.tree_util.tree_flatten(tree)
+  dim_leaves = jax.tree_util.tree_leaves(dims)
+  if len(leaves) != len(dim_leaves):
+    raise ValueError("dims tree must match the gradient tree")
+  scat = [i for i, d in enumerate(dim_leaves) if d is not None and d >= 0]
+  out = list(leaves)
+  if scat:
+    sub = []
+    for i in scat:
+      d = dim_leaves[i]
+      if leaves[i].shape[d] % num_shards:
+        raise ValueError(
+            f"leaf {i} dim {d} ({leaves[i].shape[d]}) does not divide "
+            f"num_shards={num_shards}")
+      sub.append(jnp.moveaxis(leaves[i], d, 0).reshape(num_shards, -1))
+    plan = build_fusion_plan(sub, fusion_threshold_mb, max_splits)
+    red_sub = [None] * len(sub)
+    for bucket in plan.buckets:
+      buf = jnp.concatenate([sub[j] for j in bucket], axis=1)
+      red = overlap.reduce_scatter(buf, axis_name, axis=0,
+                                   num_chunks=num_chunks)
+      offset = 0
+      for j in bucket:
+        width = sub[j].shape[1]
+        red_sub[j] = jax.lax.dynamic_slice_in_dim(red, offset, width,
+                                                  axis=1)
+        offset += width
+    for pos, i in enumerate(scat):
+      d = dim_leaves[i]
+      shape = leaves[i].shape
+      moved = (shape[d] // num_shards,) + tuple(
+          s for dim, s in enumerate(shape) if dim != d)
+      out[i] = jnp.moveaxis(red_sub[pos].reshape(moved), 0, d)
+  return jax.tree_util.tree_unflatten(treedef, out)

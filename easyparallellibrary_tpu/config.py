@@ -165,6 +165,16 @@ class CommunicationConfig(_Category):
       "sparse_as_dense": False,
       # mean | sum across replicas (reference: gradients_reduce_method).
       "gradients_reduce_method": "mean",
+      # Latency-hiding collective-matmul (communicators/overlap.py):
+      # decompose all_gather->matmul / matmul->reduce_scatter adjacencies
+      # into a compute-overlapped ppermute ring.  "auto" consults the
+      # planner's analytic crossover (parallel/planner.py:
+      # plan_collective_matmul) per site; "on"/"off" force it.  "off"
+      # emits exactly the fused programs.
+      "overlap": "auto",
+      # Ring chunk count for the overlap path (0 = let the policy pick;
+      # non-divisors of the axis size round down to the nearest divisor).
+      "overlap_chunks": 0,
   }
 
 
@@ -401,6 +411,12 @@ class Config:
     if self.communication.compress_dtype not in ("", "bf16", "fp16"):
       raise ValueError("communication.compress_dtype must be '', 'bf16' "
                        f"or 'fp16'; got {self.communication.compress_dtype!r}")
+    if self.communication.overlap not in ("auto", "on", "off"):
+      raise ValueError("communication.overlap must be 'auto', 'on' or "
+                       f"'off'; got {self.communication.overlap!r}")
+    if self.communication.overlap_chunks < 0:
+      raise ValueError("communication.overlap_chunks must be >= 0; got "
+                       f"{self.communication.overlap_chunks}")
 
   def to_dict(self) -> Dict[str, Dict[str, Any]]:
     return {c._name: getattr(self, c._name).to_dict()

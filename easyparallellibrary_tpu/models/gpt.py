@@ -1031,6 +1031,42 @@ def auto_parallel_gpt(cfg: GPTConfig, config=None) -> GPT:
   return GPT(cfg2)
 
 
+# Once-per-process latch for the engine advisory below: the recommendation
+# is identical for every trace/step, so repeating it per trace is noise.
+_SMAP_ADVICE_LOGGED = [False]
+
+
+def _smap_preconditions_ok(cfg: GPTConfig, conf, sched) -> bool:
+  """True iff ``pipeline.engine='smap'`` would accept this exact config —
+  the advisory in :func:`make_gpt_train_step` must never recommend an
+  engine that would raise on the user's model (the full constraint list
+  of :func:`make_gpt_smap_grad_fn`, not just vocab divisibility)."""
+  S = cfg.pipeline_stages
+  K = max(1, cfg.pipeline_interleave)
+  if cfg.vocab_size % S:
+    return False
+  if K > 1 and not sched.remat_stage:
+    return False  # interleave requires the 1F1B-order schedules
+  if cfg.num_experts > 0 and cfg.num_layers % (S * K):
+    return False
+  from easyparallellibrary_tpu.env import Env
+  env = Env.get()
+  sizes = {}
+  if env.cluster is not None and env.cluster._mesh is not None:
+    mesh = env.cluster._mesh
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+  model_size = sizes.get(constants.MODEL_AXIS, 1)
+  if cfg.tensor_parallel and model_size > 1 and cfg.vocab_size % model_size:
+    return False  # stage-resident CE needs an unpadded vocab table
+  seq = sizes.get(constants.SEQ_AXIS, 1)
+  if seq > 1 and cfg.attn_impl == "ring" and \
+      conf.sequence.ring_impl not in ("flash", "dense"):
+    return False  # einsum ring cannot enter the seq-manual region
+  if seq > 1 and cfg.attn_impl == "ulysses" and cfg.num_heads % seq:
+    return False
+  return True
+
+
 def make_gpt_train_step(model: GPT, config=None):
   """Config-driven train step for GPT, engine- and schedule-aware.
 
@@ -1072,9 +1108,12 @@ def make_gpt_train_step(model: GPT, config=None):
           grad_fn=make_gpt_smap_grad_fn(model, schedule=schedule),
           config=conf, num_apply_group=groups)
     from easyparallellibrary_tpu.utils.logging import get_logger
-    if cfg.vocab_size % cfg.pipeline_stages == 0:
-      # Only advise 'smap' when this config actually satisfies its
-      # constraints.
+    if not _SMAP_ADVICE_LOGGED[0] and \
+        _smap_preconditions_ok(cfg, conf, sched):
+      # Advise 'smap' ONCE per process, and only when this config
+      # satisfies the engine's FULL constraint set — a recommendation
+      # the engine would reject is worse than none.
+      _SMAP_ADVICE_LOGGED[0] = True
       get_logger().info(
           "pipeline.engine=%r runs the lockstep vmapped engine; the "
           "per-device shard_map engine (pipeline.engine='smap') "

@@ -35,6 +35,26 @@ from easyparallellibrary_tpu import constants
 
 from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
 
+# Once-per-process latch for the einsum-MoE perf-cliff advisory below.
+_EINSUM_CLIFF_WARNED = [False]
+
+
+def _expert_token_sharding(x) -> "bool | None":
+  """Inspect ``x``'s committed sharding: True = token dims (everything
+  but the trailing feature dim) are positively NOT split over the expert
+  axis (replicated over the expert group); False = they ARE
+  expert-split; None = uninspectable (a tracer without a committed
+  sharding — the common case under jit on older jax)."""
+  sharding = getattr(x, "sharding", None)
+  spec = getattr(sharding, "spec", None)
+  if spec is None:
+    return None
+  for entry in tuple(spec)[:max(getattr(x, "ndim", 1) - 1, 0)]:
+    axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+    if constants.EXPERT_AXIS in axes:
+      return False
+  return True
+
 
 def _top_k_dispatch(probs, top_k: int, E: int, capacity: int, dtype):
   """Shared top-k routing -> (dispatch [T,E,C], combine [T,E,C], assign).
@@ -107,29 +127,38 @@ class MoEMLP(nn.Module):
     T = B * S
 
     # Perf-cliff flag (docs/parallelism.md "Expert parallelism"): with
-    # tokens replicated over the expert group — this model's activations
-    # shard tokens over data/seq only — GSPMD lowers the dispatch/
-    # combine einsums to local-compute + reductions, NOT all-to-alls:
-    # every expert-group member touches every token, so EP stops scaling
-    # compute with the expert axis (measured: benchmarks/
+    # tokens replicated over the expert group, GSPMD lowers the
+    # dispatch/combine einsums to local-compute + reductions, NOT
+    # all-to-alls: every expert-group member touches every token, so EP
+    # stops scaling compute with the expert axis (measured: benchmarks/
     # moe_a2a_share.py).  moe_impl="a2a" enforces distributed tokens.
+    # Fires ONCE per process.  The ACTUAL token sharding is inspected
+    # first: a batch genuinely sharded over the expert axis suppresses
+    # the advisory entirely; a positively-replicated sharding fires the
+    # definite message; an uninspectable tracer (jit without committed
+    # input shardings) fires the hedged "IF" form once — never the old
+    # per-layer/per-trace spam.
     from easyparallellibrary_tpu.env import Env
     env = Env.get()
-    if env.cluster is not None and env.cluster._mesh is not None:
+    if not _EINSUM_CLIFF_WARNED[0] and env.cluster is not None \
+        and env.cluster._mesh is not None:
       sizes = dict(zip(env.cluster.mesh.axis_names,
                        env.cluster.mesh.devices.shape))
-      if sizes.get(constants.EXPERT_AXIS, 1) > 1:
+      replicated = _expert_token_sharding(x)
+      if sizes.get(constants.EXPERT_AXIS, 1) > 1 and replicated is not False:
+        _EINSUM_CLIFF_WARNED[0] = True
         from easyparallellibrary_tpu.utils.logging import get_logger
         get_logger().info(
-            "MoE impl='einsum' on an expert axis of size %d: IF tokens "
-            "are replicated over the expert group (the default when the "
-            "batch shards over 'data' alone), GSPMD local-computes "
-            "dispatch/combine with no all-to-all — every expert-group "
-            "member touches every token.  Shard the batch over "
-            "('data','expert') or use moe_impl='a2a' for "
+            "MoE impl='einsum' on an expert axis of size %d: %s "
+            "GSPMD local-computes dispatch/combine with no all-to-all — "
+            "every expert-group member touches every token.  Shard the "
+            "batch over ('data','expert') or use moe_impl='a2a' for "
             "distributed-token expert parallelism.  See "
-            "docs/parallelism.md.",
-            sizes[constants.EXPERT_AXIS])
+            "docs/parallelism.md.  (Logged once per process.)",
+            sizes[constants.EXPERT_AXIS],
+            "tokens are replicated over the expert group:" if replicated
+            else "IF tokens are replicated over the expert group "
+                 "(the default when the batch shards over 'data' alone),")
     capacity = max(self.top_k, int(
         math.ceil(T / E * cfg.capacity_factor)))
 
@@ -275,16 +304,19 @@ class MoEMLP(nn.Module):
     # The engines run stage compute branch-uniformly for this
     # composition (models/gpt.py), so the nested map's whole-mesh
     # collective channels are never gated.
+    from easyparallellibrary_tpu.utils.compat import shard_map
     from easyparallellibrary_tpu.utils.sharding import manual_axes
-    smap_mesh = (jax.sharding.get_abstract_mesh() if manual_axes()
+    get_abstract_mesh = getattr(jax.sharding, "get_abstract_mesh", None)
+    smap_mesh = (get_abstract_mesh()
+                 if manual_axes() and get_abstract_mesh is not None
                  else mesh)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         local_moe, mesh=smap_mesh,
         in_specs=(P(constants.EXPERT_AXIS), P(),
                   P(constants.EXPERT_AXIS), P(constants.EXPERT_AXIS)),
         out_specs=(P(constants.EXPERT_AXIS), P()),
-        axis_names=frozenset({constants.EXPERT_AXIS}),
-        check_vma=False)
+        manual_axes=frozenset({constants.EXPERT_AXIS}),
+        check=False)
     # jit here is inlined under an outer jit; it also makes EAGER
     # evaluation (flax init) work — jax 0.9's eager shard_map
     # mis-validates out_specs when axis_names is a subset of the mesh.

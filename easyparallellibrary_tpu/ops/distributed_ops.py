@@ -31,3 +31,48 @@ def distributed_equal(predictions, labels):
   shard-derived) predictions (reference bridges labels to the split
   devices via Replica2Split, epl/ops/distributed_ops.py:125-148)."""
   return jnp.equal(predictions.astype(jnp.int32), labels.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Sequence/tensor-parallel boundary dense paths (latency-hiding).
+#
+# Named-axis collective-matmuls for callers ALREADY inside a manual region
+# (the smap engines' seq-manual mode, explicit shard_map training steps):
+# the boundary where token- or feature-sharded activations meet a dense
+# layer is a gather->matmul or matmul->scatter adjacency, and these route
+# it through the chunked ppermute ring of communicators/overlap.py under
+# the ``communication.overlap`` policy (auto consults the planner's
+# crossover; off emits the fused collective unchanged).
+# ---------------------------------------------------------------------------
+
+def gather_matmul(x, w, axis_name: str = constants.SEQ_AXIS,
+                  num_chunks: int | None = None):
+  """``matmul(all_gather(x, axis=0, tiled=True), w)`` at a parallel
+  boundary — e.g. seq-sharded tokens ``[t_loc, D]`` entering a dense
+  layer whose output must see every token.  Ring-overlapped per the
+  overlap policy; bit-exact vs the fused gather+matmul."""
+  from easyparallellibrary_tpu.communicators import overlap
+  from easyparallellibrary_tpu.utils.compat import axis_size
+  n = axis_size(axis_name)
+  if num_chunks is None:
+    num_chunks = overlap.resolve_num_chunks(
+        "all_gather_matmul", n, m=x.shape[0], k=x.shape[1],
+        n_out=w.shape[1], dtype=x.dtype)
+  return overlap.all_gather_matmul(x, w, axis_name, num_chunks=num_chunks)
+
+
+def matmul_scatter(x, w, axis_name: str = constants.SEQ_AXIS,
+                   num_chunks: int | None = None):
+  """``psum_scatter(matmul(x, w), scatter_dimension=0, tiled=True)`` at a
+  parallel boundary — e.g. a row-parallel projection whose output drops
+  back to token shards.  Ring-overlapped per the overlap policy; exact to
+  accumulation-order tolerance vs the fused matmul+psum_scatter."""
+  from easyparallellibrary_tpu.communicators import overlap
+  from easyparallellibrary_tpu.utils.compat import axis_size
+  n = axis_size(axis_name)
+  if num_chunks is None:
+    num_chunks = overlap.resolve_num_chunks(
+        "matmul_reduce_scatter", n, m=x.shape[0], k=x.shape[1],
+        n_out=w.shape[1], dtype=x.dtype)
+  return overlap.matmul_reduce_scatter(x, w, axis_name,
+                                       num_chunks=num_chunks)

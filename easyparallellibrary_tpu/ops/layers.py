@@ -60,6 +60,81 @@ def _model_axis_size() -> int:
   return env.cluster.axis_size(constants.MODEL_AXIS)
 
 
+def _row_overlap_chunks(x, padded_in: int, out_features: int) -> int:
+  """Ring chunk count for a row-parallel Dense matmul under the
+  ``communication.overlap`` policy; 1 = keep the fused GSPMD program.
+
+  The ring runs as an explicit (partial-manual) shard_map over the model
+  axis, so it engages only where that region is well-defined:
+
+    * not already inside a manual region (the smap engines own their
+      schedule; a nested ring's whole-mesh permute channels would
+      deadlock against their gated ticks);
+    * every mesh axis except ``model`` has size 1 (a collective-permute
+      inside a region with live auto axes trips the older XLA SPMD
+      partitioner — the same constraint the smap engines' stage
+      ppermutes live under; pure-TP meshes are exactly the shape the
+      explicit ``split`` library targets);
+    * the flattened activation rows divide the model axis (the scatter
+      grain).
+  """
+  env = Env.get()
+  if env.cluster is None or env.cluster._mesh is None:
+    return 1
+  mesh = env.cluster._mesh
+  sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+  n = sizes.get(constants.MODEL_AXIS, 1)
+  if n <= 1:
+    return 1
+  if any(s > 1 for a, s in sizes.items() if a != constants.MODEL_AXIS):
+    return 1
+  from easyparallellibrary_tpu.utils.compat import ambient_manual_axes
+  if ambient_manual_axes():
+    return 1
+  rows = 1
+  for s in x.shape[:-1]:
+    rows *= int(s)
+  if rows % n:
+    return 1
+  from easyparallellibrary_tpu.communicators import overlap as _overlap
+  return _overlap.resolve_num_chunks(
+      "matmul_reduce_scatter", n, m=rows, k=padded_in // n,
+      n_out=out_features, dtype=x.dtype)
+
+
+def _row_overlap_matmul(x, kernel, dtype, num_chunks: int):
+  """Row-parallel matmul + reduction as an explicit collective-matmul:
+  ``matmul -> ring reduce_scatter`` (compute-overlapped,
+  communicators/overlap.py) then an all-gather rebuilding the replicated
+  activation — together the same bytes as the fused all-reduce GSPMD
+  inserts, with the scatter half hidden under the matmul."""
+  from easyparallellibrary_tpu.communicators import overlap as _overlap
+  from easyparallellibrary_tpu.utils.compat import shard_map
+  mesh = Env.get().cluster.mesh
+  lead = x.shape[:-1]
+  rows = 1
+  for s in lead:
+    rows *= int(s)
+  n_out = kernel.shape[-1]
+
+  def body(xl, wl):
+    xf = xl.astype(dtype).reshape(rows, xl.shape[-1])
+    y = _overlap.matmul_reduce_scatter(xf, jnp.asarray(wl, dtype),
+                                       constants.MODEL_AXIS,
+                                       num_chunks=num_chunks)
+    y = jax.lax.all_gather(y, constants.MODEL_AXIS, axis=0, tiled=True)
+    return y.reshape(lead + (n_out,))
+
+  nd = len(lead)
+  f = shard_map(
+      body, mesh,
+      in_specs=(P(*([None] * nd), constants.MODEL_AXIS),
+                P(constants.MODEL_AXIS, None)),
+      out_specs=P(*([None] * nd), None),
+      manual_axes=frozenset({constants.MODEL_AXIS}))
+  return f(x, kernel)
+
+
 def _round_up(dim: int, multiple: int) -> int:
   return ((dim + multiple - 1) // multiple) * multiple
 
@@ -193,13 +268,21 @@ class Dense(nn.Module):
 
     kernel = self.param("kernel", kernel_init, kshape, self.param_dtype)
     dtype = self.dtype or x.dtype
-    y = jnp.matmul(x.astype(dtype), jnp.asarray(kernel, dtype))
+    row_chunks = (_row_overlap_chunks(x, kshape[0], out_features)
+                  if mode == "row" else 1)
+    if row_chunks >= 2:
+      # Latency-hiding collective-matmul: the fused matmul+psum becomes
+      # matmul -> ring reduce_scatter (overlapped) -> all_gather.  Same
+      # wire bytes as the all-reduce, scatter half hidden under the MXU.
+      y = _row_overlap_matmul(x, kernel, dtype, row_chunks)
+    else:
+      y = jnp.matmul(x.astype(dtype), jnp.asarray(kernel, dtype))
     if mode == "column":
       # Leading dims UNCONSTRAINED: only the feature dim is pinned to the
       # model axis (None would force batch/seq to gather here).
       y = _constraint(y, P(*([P.UNCONSTRAINED] * (y.ndim - 1)),
                            constants.MODEL_AXIS))
-    elif mode == "row":
+    elif mode == "row" and row_chunks < 2:
       # The contraction over the model-sharded dim makes XLA insert the
       # psum from dataflow; pin only the feature dim off the model axis.
       y = _constraint(y, P(*([P.UNCONSTRAINED] * (y.ndim - 1)), None))

@@ -71,11 +71,12 @@ def make_explicit_dp_train_step(loss_fn: Callable,
     return new_state, metrics
 
   batch_spec = P(constants.DATA_AXIS)
-  mapped = jax.shard_map(
+  from easyparallellibrary_tpu.utils.compat import shard_map
+  mapped = shard_map(
       sharded_step,
       mesh=mesh,
       in_specs=(P(), batch_spec, P()),
       out_specs=(P(), P()),
-      check_vma=False,
+      check=False,
   )
   return jax.jit(mapped, donate_argnums=(0,))

@@ -523,13 +523,14 @@ def make_smap_interleaved_grad_fn(feed_fn: Callable,
                                                constants.DATA_AXIS)}
     return (loss, metrics), G
 
-  mapped = jax.shard_map(
+  from easyparallellibrary_tpu.utils.compat import shard_map
+  mapped = shard_map(
       local_grad, mesh=mesh,
       in_specs=(param_specs, bspec, P(), P()),
       out_specs=((P(), {"stage_aux_loss": P()}),
                  grad_out_specs(param_specs, zero1)),
-      axis_names=manual_axes if manual_axes is not None else frozenset(),
-      check_vma=False)
+      manual_axes=manual_axes,
+      check=False)
 
   def grad_fn(params, mbs, rng, loss_scale=None):
     return mapped(params, mbs, rng, loss_scale)

@@ -61,6 +61,65 @@ def compiled_cost(fn: Callable, *args, **kwargs) -> Dict[str, float]:
   return dict(cost or {})
 
 
+# StableHLO collective ops whose result bytes count as wire traffic.
+_COLLECTIVE_OPS = ("all_gather", "all_reduce", "reduce_scatter",
+                   "collective_permute", "all_to_all",
+                   "collective_broadcast")
+_TENSOR_RE = None  # compiled lazily (keeps `re` out of the hot import)
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8": 1,
+                "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2,
+                "ui16": 2, "i8": 1, "ui8": 1, "i1": 1}
+
+
+def collective_bytes(fn: Callable, *args, **kwargs) -> float:
+  """Bytes produced by collective ops in the lowered program of
+  ``fn(*args)`` — the comm-traffic counter feeding the profiler's
+  comm-share line.  Counted from the StableHLO text (result tensor types
+  of all_gather / all_reduce / reduce_scatter / collective_permute /
+  all_to_all), the same program the XLA cost model scores, so the flops
+  and comm numbers describe one artifact."""
+  import re
+  global _TENSOR_RE
+  if _TENSOR_RE is None:
+    _TENSOR_RE = re.compile(r"tensor<([0-9x]*)x?([a-z]+[0-9]+)>")
+  text = jax.jit(fn).lower(*args, **kwargs).as_text()
+
+  def result_bytes(tail: str) -> float:
+    sub = 0.0
+    for dims, dtype in _TENSOR_RE.findall(tail):
+      elems = 1
+      for d in dims.split("x"):
+        if d:
+          elems *= int(d)
+      sub += elems * _DTYPE_BYTES.get(dtype, 4)
+    return sub
+
+  total = 0.0
+  awaiting_close = False
+  for line in text.splitlines():
+    if awaiting_close:
+      # Region-bearing collectives (all_reduce/reduce_scatter carry a
+      # reduction body) print their type signature on the CLOSING
+      # `}) : (...) -> ...` line, not the op line — count it there and
+      # ignore the body lines in between.
+      if "})" in line and "->" in line:
+        total += result_bytes(line.rsplit("->", 1)[-1])
+        awaiting_close = False
+      continue
+    if not any(f"stablehlo.{op}" in line or f'"{op}"' in line
+               for op in _COLLECTIVE_OPS):
+      continue
+    if "->" in line:
+      # Inline form: result type follows the last `->`.  (Attribute
+      # tensors like replica_groups sit BEFORE the arrow and are not
+      # counted.)
+      total += result_bytes(line.rsplit("->", 1)[-1])
+    else:
+      awaiting_close = True
+  return total
+
+
 def estimate_mfu(flops_per_step: float, step_time_s: float,
                  n_chips: Optional[int] = None) -> float:
   n_chips = n_chips or len(jax.devices())
@@ -73,17 +132,36 @@ class FlopsProfiler:
   epl/profiler/flops.py:120-158: capture once, then log per scope)."""
 
   def __init__(self, flops_per_step: Optional[float] = None,
-               every_n_steps: int = 100):
+               every_n_steps: int = 100,
+               comm_bytes_per_step: Optional[float] = None,
+               link_bytes_per_s: Optional[float] = None):
     self.flops_per_step = flops_per_step
     self.every_n_steps = every_n_steps
+    # Collective-traffic counters for the comm-share line: what fraction
+    # of the step the wire would need at `link_bytes_per_s` — the
+    # quantity the overlap crossover (parallel/planner.py:
+    # plan_collective_matmul) trades against MXU time.  > ~1/2 means the
+    # step is communication-bound and latency-hiding collectives
+    # (communication.overlap) have headroom to claim.
+    self.comm_bytes_per_step = comm_bytes_per_step
+    if link_bytes_per_s is None:
+      from easyparallellibrary_tpu.parallel.planner import (
+          DEFAULT_ICI_BYTES_PER_S)
+      link_bytes_per_s = DEFAULT_ICI_BYTES_PER_S
+    self.link_bytes_per_s = link_bytes_per_s
     self._t0 = None
     self._step0 = 0
     self._step = 0
 
   def measure_from(self, fn: Callable, *args, **kwargs):
-    """Fill flops_per_step from XLA's cost model."""
+    """Fill flops_per_step (and the comm counter) from XLA's cost model
+    and the lowered program."""
     cost = compiled_cost(fn, *args, **kwargs)
     self.flops_per_step = float(cost.get("flops", 0.0))
+    try:
+      self.comm_bytes_per_step = collective_bytes(fn, *args, **kwargs)
+    except Exception:  # comm counter is best-effort; flops must survive
+      self.comm_bytes_per_step = None
     return self.flops_per_step
 
   def step(self) -> Optional[Dict[str, float]]:
@@ -102,5 +180,11 @@ class FlopsProfiler:
     if self.flops_per_step:
       stats["gflops_per_step"] = self.flops_per_step / 1e9
       stats["mfu"] = estimate_mfu(self.flops_per_step, dt)
+    if self.comm_bytes_per_step:
+      stats["comm_gb_per_step"] = self.comm_bytes_per_step / 1e9
+      # Wire-time share of the step at the modeled link bandwidth; the
+      # overlap policy's headroom indicator.
+      stats["comm_share"] = min(
+          self.comm_bytes_per_step / self.link_bytes_per_s / dt, 1.0)
     get_logger().info("flops profiler: %s", stats)
     return stats

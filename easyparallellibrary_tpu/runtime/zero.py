@@ -33,23 +33,35 @@ from easyparallellibrary_tpu import constants
 from easyparallellibrary_tpu.utils.logging import get_logger
 
 
+def zero_owner_dim(shape, taken, data_size: int):
+  """THE ZeRO owner-dim rule: first dimension that is not already
+  sharded (``taken[dim]`` falsy) and divisible by ``data_size``, or
+  ``None`` when the leaf stays replicated (reference keeps remainder
+  vars on worker 0, epl/runtime/zero.py:105-115).
+
+  Single source of truth shared by :func:`_shard_leaf_spec` (the
+  v0/v1 optimizer-state layout) and the engines'
+  ``pipeline_smap.zero1_grad_layout`` (the grad reduce-scatter layout) —
+  the two MUST agree or the scattered grads land misaligned with the
+  owner's optimizer shard and GSPMD reshards between them.
+  """
+  if not shape or data_size <= 1:
+    return None
+  for dim, size in enumerate(shape):
+    if not taken[dim] and size % data_size == 0 and size >= data_size:
+      return dim
+  return None
+
+
 def _shard_leaf_spec(abstract_leaf, spec: P, data_size: int) -> P:
   """Add `data` to the first unsharded, divisible dimension of the spec."""
   shape = getattr(abstract_leaf, "shape", ())
-  if not shape or data_size <= 1:
-    return spec
   entries = list(spec) + [None] * (len(shape) - len(spec))
-  for dim, size in enumerate(shape):
-    current = entries[dim]
-    if current is None and size % data_size == 0 and size >= data_size:
-      entries[dim] = constants.DATA_AXIS
-      return P(*entries)
-    if current is not None:
-      # Already sharded (e.g. tensor-parallel dim) — try combining data
-      # on top only if evenly divisible by both.
-      continue
-  return spec  # nothing shardable; stays replicated (reference keeps
-               # remainder vars on worker 0, epl/runtime/zero.py:105-115)
+  dim = zero_owner_dim(shape, [e is not None for e in entries], data_size)
+  if dim is None:
+    return spec  # nothing shardable; stays replicated
+  entries[dim] = constants.DATA_AXIS
+  return P(*entries)
 
 
 def shard_opt_state(abstract_state, shardings, mesh: Mesh, level: str):
@@ -281,11 +293,12 @@ def make_zero1_train_step(loss_fn: Callable, mesh: Mesh) -> Callable:
       import flax.linen as nn
       _assert_elementwise_tx(state.tx, nn.meta.unbox(state.params))
       in_state_specs = state_specs(jax.eval_shape(lambda s: s, state))
-      mapped = jax.shard_map(
+      from easyparallellibrary_tpu.utils.compat import shard_map
+      mapped = shard_map(
           sharded_step, mesh=mesh,
           in_specs=(in_state_specs, P(constants.DATA_AXIS), P()),
           out_specs=(in_state_specs, P()),
-          check_vma=False)
+          check=False)
       compiled["fn"] = jax.jit(mapped, donate_argnums=(0,))
       step.jitted = compiled["fn"]
     return compiled["fn"](state, batch, rng)

@@ -124,8 +124,10 @@ def _ring_step(qb, kb, vb, acc, r, n, causal):
 
 
 def _rot(x, n):
-  return jax.lax.ppermute(x, constants.SEQ_AXIS,
-                          [(i, (i + 1) % n) for i in range(n)])
+  # Shared ring-step primitive with the chunked collective-matmuls
+  # (communicators/overlap.py) — one ring plan, two consumers.
+  from easyparallellibrary_tpu.communicators.overlap import ring_step
+  return ring_step(x, constants.SEQ_AXIS, n)
 
 
 # ---------------------------------------------------- block-compute impl --
@@ -617,8 +619,9 @@ def _ring_flash(q, k, v, causal: bool):
   bax = axis_if_divisible(B, mesh, constants.DATA_AXIS)
   hax = axis_if_divisible(H, mesh, constants.MODEL_AXIS)
   spec = P(bax, constants.SEQ_AXIS, hax, None)
-  return jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
-                       out_specs=spec, check_vma=False)(q, k, v)
+  from easyparallellibrary_tpu.utils.compat import shard_map
+  return shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
+                   out_specs=spec, check=False)(q, k, v)
 
 
 def ring_attention(q, k, v, causal: bool = True,

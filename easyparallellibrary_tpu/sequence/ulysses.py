@@ -130,8 +130,9 @@ def _ulysses_flash(q, k, v, causal: bool):
         "make the region manual over the seq axis too (the smap "
         "engines do this when attn_impl='ulysses'), or use the vmapped "
         "pipeline engines for pipeline x sequence hybrids.")
-  out = jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
-                      out_specs=spec, check_vma=False)(q, k, v)
+  from easyparallellibrary_tpu.utils.compat import shard_map
+  out = shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
+                  out_specs=spec, check=False)(q, k, v)
   return _constrain(out, SEQ_SHARDED)
 
 

@@ -28,8 +28,8 @@ def manual_axes() -> frozenset:
   """Mesh axes that are Manual in the ambient shard_map region (empty
   outside one).  The single compatibility shim for the abstract-mesh
   API — consult this, not jax.sharding directly."""
-  return frozenset(
-      getattr(jax.sharding.get_abstract_mesh(), "manual_axes", ()) or ())
+  from easyparallellibrary_tpu.utils.compat import ambient_manual_axes
+  return ambient_manual_axes()
 
 
 _warned_sites = set()

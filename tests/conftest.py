@@ -26,6 +26,16 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+  config.addinivalue_line(
+      "markers", "slow: heavyweight tests excluded from the tier-1 run "
+      "(`-m 'not slow'`)")
+  config.addinivalue_line(
+      "markers", "quick: one exactness test per composition "
+      "(DP/TP/PP/SP/MoE/ZeRO/overlap) — `pytest -m quick` re-runs the "
+      "whole matrix in <5 min on one core")
+
+
 @pytest.fixture(autouse=True)
 def _reset_epl_env():
   """Each test gets a fresh Env (the reference resets Env in epl.init)."""

@@ -5,6 +5,7 @@ AutoStageGenerator from the build, tests/auto_parallel_test.py)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 
 import easyparallellibrary_tpu as epl
@@ -52,6 +53,7 @@ def test_auto_parallel_off_passthrough():
   assert model.cfg.stage_plan is None
 
 
+@pytest.mark.slow
 def test_auto_partitioned_gpt_trains_and_matches_manual():
   """VERDICT done-criterion: auto-partitioned GPT with uneven block
   weights trains; its loss matches the manually partitioned model with

@@ -51,8 +51,10 @@ def test_timestamps_autofilled(tmp_path):
 
 
 def test_bench_fallback_reports_evidence_not_zero(tmp_path):
-  """bench.py with an exhausted probe budget must emit the evidence
-  record's value, flagged as a fallback, with the raw data inline."""
+  """bench.py with an exhausted probe budget must emit a NULL headline
+  value with the evidence record's number under `last_known` (a stale
+  MFU must be unquotable as a fresh measurement, VERDICT weak #6), with
+  the raw data inline."""
   p = str(tmp_path / "ev.json")
   bench_evidence.append_record(
       {"metric": "gpt350m_train_mfu", "value": 0.51, "unit": "mfu",
@@ -70,6 +72,10 @@ def test_bench_fallback_reports_evidence_not_zero(tmp_path):
                        capture_output=True, text=True, env=env, timeout=120)
   line = out.stdout.strip().splitlines()[-1]
   result = json.loads(line)
-  assert result["value"] == 0.51
+  assert result["value"] is None
+  assert result["vs_baseline"] is None
+  assert result["stale"] is True
+  assert result["last_known"] == 0.51
+  assert result["last_known_vs_baseline"] == round(0.51 / 0.40, 4)
   assert result["detail"]["fallback"] == "evidence"
   assert result["detail"]["raw"] == {"chain_times_s": [1.0]}

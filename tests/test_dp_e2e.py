@@ -5,6 +5,7 @@ loss-comparison style of tests/zero_test.py)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 from flax import linen as nn
 
@@ -94,6 +95,7 @@ def _train_baseline(n_steps=5):
   return losses, jax.device_get(params)
 
 
+@pytest.mark.quick
 def test_dp_matches_single_device():
   dp_losses, dp_params = _train()
   base_losses, base_params = _train_baseline()

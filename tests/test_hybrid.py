@@ -5,6 +5,7 @@ colocated split (README.md:58-70); this exercises all three plus ZeRO."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 
 import easyparallellibrary_tpu as epl
@@ -101,6 +102,7 @@ def test_hybrid_matches_pure_dp():
   np.testing.assert_allclose(run(True), run(False), rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_pp_seq_tp_compose():
   """Pipeline x sequence x tensor parallel on one mesh (stage2 x seq2 x
   model2, data=1): the full-axis composition compiles and trains."""

@@ -68,6 +68,7 @@ def test_bert_mlm_training():
   assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_resnet_dp_training_with_split_head():
   env = epl.init()
   with epl.split(2):
@@ -411,6 +412,7 @@ def test_bert_ring_attention_matches_xla():
   np.testing.assert_allclose(out_r, out_x, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 def test_bert_smap_sequence_parallel_matches_sequential(impl):
   """The encoder family composes with sequence parallelism on the smap

@@ -21,6 +21,7 @@ CFG = GPTConfig(vocab_size=64, num_layers=2, num_heads=4, d_model=16,
                 num_experts=4, capacity_factor=2.0)
 
 
+@pytest.mark.quick
 def test_moe_forward_matches_naive_routing():
   """With ample capacity, output == per-token expert(token) * gate."""
   moe = MoEMLP(dataclasses.replace(CFG, capacity_factor=8.0))

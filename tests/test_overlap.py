@@ -1,0 +1,418 @@
+"""Latency-hiding collective-matmul tests (communicators/overlap.py).
+
+Every exactness test compares the overlapped (ring-decomposed) program
+against the fused ground truth on the 8-device virtual mesh:
+all-gather-matmul is BIT-exact (same row-block dots); the
+reduce-scatter family agrees to accumulation-order tolerance (the ring
+sums per-device in a different order than XLA's fused reduction).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu import constants, ops
+from easyparallellibrary_tpu.communicators import fusion, overlap
+from easyparallellibrary_tpu.parallel import (
+    TrainState, create_sharded_train_state, parallelize)
+from easyparallellibrary_tpu.parallel.planner import plan_collective_matmul
+from easyparallellibrary_tpu.utils.compat import shard_map
+
+
+def _mesh1d(axis="model"):
+  return Mesh(np.array(jax.devices()).reshape(8), (axis,))
+
+
+# ----------------------------------------------------------- primitives --
+
+@pytest.mark.parametrize("K", [2, 4, 8, 3])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_all_gather_matmul_exact(K, dtype):
+  """Ring AG->matmul is bit-exact vs matmul(all_gather(x), w) — same
+  row-block dots, only the schedule differs (K=3 rounds down to 2)."""
+  mesh = _mesh1d()
+  r = np.random.RandomState(0)
+  x = jnp.asarray(r.randn(8 * 4, 16), dtype)
+  w = jnp.asarray(r.randn(16, 12), dtype)
+
+  def ring(xl, wl):
+    return overlap.all_gather_matmul(xl, wl, "model", K)
+
+  def fused(xl, wl):
+    return jnp.matmul(jax.lax.all_gather(xl, "model", axis=0, tiled=True),
+                      wl)
+
+  specs = dict(in_specs=(P("model", None), P(None, None)),
+               out_specs=P(None, None))
+  got = jax.jit(shard_map(ring, mesh, **specs))(x, w)
+  ref = jax.jit(shard_map(fused, mesh, **specs))(x, w)
+  np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("K", [1, 2, 4, 8])
+def test_matmul_reduce_scatter_exact(K):
+  """Ring matmul->RS equals psum_scatter(matmul) to accumulation-order
+  tolerance for every chunk count in the sweep."""
+  mesh = _mesh1d()
+  r = np.random.RandomState(1)
+  x = jnp.asarray(r.randn(16, 8 * 8), jnp.float32)
+  w = jnp.asarray(r.randn(8 * 8, 12), jnp.float32)
+
+  def ring(xl, wl):
+    return overlap.matmul_reduce_scatter(xl, wl, "model", K)
+
+  def fused(xl, wl):
+    return jax.lax.psum_scatter(jnp.matmul(xl, wl), "model",
+                                scatter_dimension=0, tiled=True)
+
+  specs = dict(in_specs=(P(None, "model"), P("model", None)),
+               out_specs=P("model", None))
+  got = jax.jit(shard_map(ring, mesh, **specs))(x, w)
+  ref = jax.jit(shard_map(fused, mesh, **specs))(x, w)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                             rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("axis_dim", [0, 1])
+def test_reduce_scatter_ring_matches_psum_scatter(axis_dim):
+  mesh = _mesh1d("data")
+  r = np.random.RandomState(2)
+  x = jnp.asarray(r.randn(16, 24), jnp.float32)
+
+  def cmp(xl):
+    a = overlap.reduce_scatter(xl, "data", axis=axis_dim, num_chunks=8)
+    b = jax.lax.psum_scatter(xl, "data", scatter_dimension=axis_dim,
+                             tiled=True)
+    return jnp.max(jnp.abs(a - b))[None]
+
+  out = jax.jit(shard_map(cmp, mesh, in_specs=P(None, None),
+                          out_specs=P("data")))(x)
+  assert float(jnp.max(out)) < 1e-5
+
+
+def test_overlap_chunk1_is_fused_program():
+  """num_chunks<=1 must emit the fused collective — no ring permutes in
+  the lowered program (the comm.overlap=off contract)."""
+  mesh = _mesh1d()
+  x = jnp.ones((32, 16))
+  w = jnp.ones((16, 8))
+  txt = jax.jit(shard_map(
+      lambda a, b: overlap.all_gather_matmul(a, b, "model", 1),
+      mesh, in_specs=(P("model", None), P(None, None)),
+      out_specs=P(None, None))).lower(x, w).as_text()
+  assert "collective_permute" not in txt and "collective-permute" not in txt
+  assert "all_gather" in txt or "all-gather" in txt
+  txt8 = jax.jit(shard_map(
+      lambda a, b: overlap.all_gather_matmul(a, b, "model", 8),
+      mesh, in_specs=(P("model", None), P(None, None)),
+      out_specs=P(None, None))).lower(x, w).as_text()
+  assert "collective_permute" in txt8 or "collective-permute" in txt8
+
+
+def test_normalize_chunks():
+  assert overlap.normalize_chunks(0, 8) == 1
+  assert overlap.normalize_chunks(1, 8) == 1
+  assert overlap.normalize_chunks(8, 8) == 8
+  assert overlap.normalize_chunks(5, 8) == 4   # round down to a divisor
+  assert overlap.normalize_chunks(16, 8) == 8  # clamp to the axis
+  assert overlap.normalize_chunks(4, 1) == 1   # no axis, no ring
+  assert overlap.normalize_chunks(3, 6) == 3
+
+
+# ------------------------------------------------- seq-manual boundaries --
+
+def test_seq_boundary_helpers_inside_seq_manual_region():
+  """The distributed-dense boundary pair (ops.distributed_ops) runs
+  inside a seq-manual region — the smap engines' composition — and
+  matches the fused gather/scatter programs."""
+  env = epl.init(epl.Config({"communication.overlap": "on"}))
+  mesh = env.cluster.build_mesh(seq=8)
+  from easyparallellibrary_tpu.ops import distributed_ops as dops
+  r = np.random.RandomState(3)
+  x = jnp.asarray(r.randn(8 * 4, 16), jnp.float32)   # seq-sharded tokens
+  w = jnp.asarray(r.randn(16, 16), jnp.float32)
+  w2 = jnp.asarray(r.randn(16, 16), jnp.float32)
+
+  def boundary(xl, wl, w2l):
+    # Enter: seq-sharded tokens gathered into the dense layer.
+    h = dops.gather_matmul(xl, wl, constants.SEQ_AXIS)
+    # Exit: a row-parallel projection — each seq peer contracts its own
+    # feature slice (w2 arrives contraction-sharded over seq) and the
+    # partial products reduce-scatter back to token shards.
+    d = jax.lax.axis_index(constants.SEQ_AXIS)
+    kloc = w2l.shape[0]
+    h_part = jax.lax.dynamic_slice_in_dim(h, d * kloc, kloc, axis=1)
+    return dops.matmul_scatter(h_part, w2l, constants.SEQ_AXIS)
+
+  got = jax.jit(shard_map(
+      boundary, mesh,
+      in_specs=(P(constants.SEQ_AXIS, None), P(),
+                P(constants.SEQ_AXIS, None)),
+      out_specs=P(constants.SEQ_AXIS, None),
+      manual_axes=frozenset({constants.SEQ_AXIS})))(x, w, w2)
+  ref = (x @ w) @ w2
+  np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                             rtol=2e-4, atol=2e-4)
+
+
+# --------------------------------------------------------- Dense row path --
+
+class _TPNet(nn.Module):
+  hidden: int = 64
+
+  @nn.compact
+  def __call__(self, x):
+    with epl.split():
+      h = ops.Dense(self.hidden, parallel="column")(x)
+      h = nn.relu(h)
+      h = ops.Dense(self.hidden, parallel="row")(h)
+    return h
+
+
+def _run_tp_dense(overlap_mode):
+  env = epl.init(epl.Config({"communication.overlap": overlap_mode}))
+  model = _TPNet()
+  with epl.split():
+    pass
+  mesh = epl.current_plan().build_mesh()
+  x = jnp.asarray(np.random.RandomState(0).randn(32, 16), jnp.float32)
+  params = model.init(jax.random.PRNGKey(0), x)["params"]
+
+  @jax.jit
+  def fwd(p, xx):
+    return model.apply({"params": p}, xx)
+
+  from flax import linen as fnn
+  return fwd(fnn.meta.unbox(params), x), fwd, params, x
+
+
+@pytest.mark.quick
+def test_dense_row_overlap_matches_fused():
+  """Row-parallel Dense under comm.overlap=on produces the same
+  activations as the fused GSPMD program, and its lowered step really
+  carries the ring (collective-permute)."""
+  out_on, fwd_on, params, x = _run_tp_dense("on")
+  out_off, *_ = _run_tp_dense("off")
+  np.testing.assert_allclose(np.asarray(out_on), np.asarray(out_off),
+                             rtol=2e-5, atol=2e-5)
+  from flax import linen as fnn
+  txt = fwd_on.lower(fnn.meta.unbox(params), x).as_text()
+  assert "collective_permute" in txt or "collective-permute" in txt
+
+
+def test_dense_row_overlap_off_keeps_program_clean():
+  out_off, fwd_off, params, x = _run_tp_dense("off")
+  from flax import linen as fnn
+  txt = fwd_off.lower(fnn.meta.unbox(params), x).as_text()
+  assert "collective_permute" not in txt and "collective-permute" not in txt
+
+
+def test_dense_row_overlap_grads_match():
+  """The ring differentiates: grads under overlap=on match fused."""
+  def grads(mode):
+    env = epl.init(epl.Config({"communication.overlap": mode}))
+    model = _TPNet()
+    with epl.split():
+      pass
+    epl.current_plan().build_mesh()
+    x = jnp.asarray(np.random.RandomState(0).randn(32, 16), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    from flax import linen as fnn
+
+    def loss(p):
+      return jnp.sum(model.apply({"params": p}, x) ** 2)
+
+    return jax.jit(jax.grad(loss))(fnn.meta.unbox(params))
+
+  g_on = grads("on")
+  g_off = grads("off")
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_allclose(
+          np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4),
+      g_on, g_off)
+
+
+# ---------------------------------------------------- ZeRO-1 smap engine --
+
+def _run_smap_zero1(overlap_mode):
+  from easyparallellibrary_tpu.models import GPT, GPTConfig
+  from easyparallellibrary_tpu.models.gpt import make_gpt_train_step
+  conf = {"pipeline.engine": "smap", "zero.level": "v1",
+          "communication.overlap": overlap_mode}
+  env = epl.init(epl.Config(conf))
+  cfg = GPTConfig(vocab_size=64, num_layers=4, num_heads=4, d_model=32,
+                  d_ff=64, max_seq_len=16, dtype=jnp.float32,
+                  pipeline_stages=2, num_micro_batch=2)
+  with epl.replicate(1):
+    model = GPT(cfg)
+  mesh = env.cluster.build_mesh(stage=2)
+  ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 17)),
+                    jnp.int32)
+
+  def init_fn(rng):
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=model.init(rng, ids[:, :-1])["params"],
+        tx=optax.adam(1e-2))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0), zero_level="v1")
+  step = parallelize(make_gpt_train_step(model), mesh, shardings)
+  losses = []
+  for i in range(4):
+    state, m = step(state, {"ids": ids}, jax.random.PRNGKey(i))
+    losses.append(float(m["loss"]))
+  txt = step.jitted.lower(state, {"ids": ids},
+                          jax.random.PRNGKey(9)).as_text()
+  return losses, txt
+
+
+@pytest.mark.quick
+def test_smap_zero1_overlap_matches_fused():
+  """smap engine x ZeRO-1: the bucketed ring reduce-to-owner
+  (comm.overlap=on routes _reduce_grads through
+  fusion.batch_reduce_scatter) trains identically to the fused per-leaf
+  psum_scatter, and the ring really lowers (collective-permute present
+  only under overlap)."""
+  on_losses, on_txt = _run_smap_zero1("on")
+  off_losses, off_txt = _run_smap_zero1("off")
+  np.testing.assert_allclose(on_losses, off_losses, rtol=2e-5)
+  assert "collective-permute" in on_txt or "collective_permute" in on_txt
+
+
+def test_batch_reduce_scatter_matches_per_leaf():
+  """fusion.batch_reduce_scatter (bucketed, ring) == per-leaf fused
+  psum_scatter for a mixed tree, owner dims included."""
+  mesh = _mesh1d("data")
+  r = np.random.RandomState(4)
+  tree = {
+      "a": jnp.asarray(r.randn(16, 6), jnp.float32),   # dim 0
+      "b": jnp.asarray(r.randn(5, 24), jnp.float32),   # dim 1
+      "c": jnp.asarray(r.randn(3, 3), jnp.float32),    # replicated
+  }
+  dims = {"a": 0, "b": 1, "c": -1}
+
+  def body(t):
+    fused_out = {
+        "a": jax.lax.psum_scatter(t["a"], "data", scatter_dimension=0,
+                                  tiled=True),
+        "b": jax.lax.psum_scatter(t["b"], "data", scatter_dimension=1,
+                                  tiled=True),
+        "c": t["c"],
+    }
+    ring_out = fusion.batch_reduce_scatter(t, "data", dims, 8,
+                                           num_chunks=8)
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.max(jnp.abs(x - y))[None], ring_out, fused_out)
+
+  spec = {"a": P(), "b": P(), "c": P()}
+  out_spec = {"a": P("data"), "b": P("data"), "c": P("data")}
+  diffs = jax.jit(shard_map(body, mesh, in_specs=(spec,),
+                            out_specs=out_spec))(tree)
+  assert max(float(jnp.max(v))
+             for v in jax.tree_util.tree_leaves(diffs)) < 1e-5
+
+
+# ----------------------------------------------------------------- policy --
+
+def test_planner_crossover_off_below_on_above():
+  """The auto policy's analytic model: tiny matmuls stay fused (per-step
+  latency dominates), large comm-heavy ones decompose."""
+  small = plan_collective_matmul("all_gather_matmul", m=8, k=32, n_out=32,
+                                 axis_size=8, dtype_bytes=4)
+  assert not small.enabled and small.num_chunks == 1
+  big = plan_collective_matmul("all_gather_matmul", m=4096, k=8192,
+                               n_out=8192, axis_size=8, dtype_bytes=2)
+  assert big.enabled
+  assert big.num_chunks >= 2 and 8 % big.num_chunks == 0
+  assert big.overlapped_us < big.fused_us
+  # Pinned chunk count is honored (rounded to a divisor).
+  pinned = plan_collective_matmul("all_gather_matmul", m=4096, k=8192,
+                                  n_out=8192, axis_size=8, dtype_bytes=2,
+                                  num_chunks=4)
+  assert pinned.num_chunks in (1, 4)
+
+
+def test_resolve_num_chunks_policies():
+  cfg_off = epl.Config({"communication.overlap": "off"})
+  assert overlap.resolve_num_chunks("all_gather_matmul", 8, m=4096, k=8192,
+                                    n_out=8192, config=cfg_off) == 1
+  cfg_on = epl.Config({"communication.overlap": "on"})
+  assert overlap.resolve_num_chunks("all_gather_matmul", 8, m=8, k=8,
+                                    n_out=8, config=cfg_on) == 8
+  cfg_on4 = epl.Config({"communication.overlap": "on",
+                        "communication.overlap_chunks": 4})
+  assert overlap.resolve_num_chunks("all_gather_matmul", 8, m=8, k=8,
+                                    n_out=8, config=cfg_on4) == 4
+  cfg_auto = epl.Config({})
+  assert cfg_auto.communication.overlap == "auto"
+  assert overlap.resolve_num_chunks("all_gather_matmul", 8, m=8, k=8,
+                                    n_out=8, config=cfg_auto) == 1
+  assert overlap.resolve_num_chunks(
+      "all_gather_matmul", 8, m=4096, k=8192, n_out=8192,
+      dtype=jnp.bfloat16, config=cfg_auto) >= 2
+
+
+def test_overlap_config_validation():
+  with pytest.raises(ValueError):
+    epl.Config({"communication.overlap": "maybe"})
+  with pytest.raises(ValueError):
+    epl.Config({"communication.overlap_chunks": -2})
+
+
+def test_collective_bytes_counter():
+  """profiler.flops.collective_bytes sees collective traffic and ignores
+  pure compute (the comm-share line's counter)."""
+  from easyparallellibrary_tpu.profiler.flops import collective_bytes
+  mesh = _mesh1d("data")
+  x = jnp.ones((16, 8))
+
+  def with_comm(v):
+    f = shard_map(lambda u: jax.lax.psum(u, "data"), mesh,
+                  in_specs=P("data", None), out_specs=P(None, None))
+    return f(v)
+
+  assert collective_bytes(with_comm, x) > 0
+  assert collective_bytes(lambda v: v @ v.T, x) == 0.0
+
+
+def test_planner_from_cost_model_path():
+  """The profiled-cost twin: flops measured by XLA's cost analysis feed
+  the same crossover model and produce a consistent verdict."""
+  from easyparallellibrary_tpu.parallel.planner import (
+      plan_collective_matmul_from_cost)
+  x = jnp.ones((512, 2048), jnp.float32)
+  w = jnp.ones((2048, 2048), jnp.float32)
+  dec = plan_collective_matmul_from_cost(
+      lambda a, b: a @ b, x, w, kind="matmul_reduce_scatter", axis_size=8,
+      k=2048, n_out=2048, dtype_bytes=4)
+  assert dec.matmul_us > 0
+  assert dec.num_chunks == 1 or 8 % dec.num_chunks == 0
+
+
+def test_flops_profiler_reports_comm_share():
+  """FlopsProfiler's comm-share line: measure_from fills the collective
+  counter and step() reports comm_gb_per_step + comm_share."""
+  from easyparallellibrary_tpu.profiler.flops import FlopsProfiler
+  mesh = _mesh1d("data")
+  x = jnp.ones((16, 8))
+
+  def step_fn(v):
+    f = shard_map(lambda u: jax.lax.psum(u, "data"), mesh,
+                  in_specs=P("data", None), out_specs=P(None, None))
+    return f(v)
+
+  prof = FlopsProfiler(every_n_steps=1)
+  prof.measure_from(step_fn, x)
+  assert prof.comm_bytes_per_step and prof.comm_bytes_per_step > 0
+  prof.step()          # arms the timer
+  stats = prof.step()  # first report
+  assert stats is not None
+  assert "comm_share" in stats and 0.0 <= stats["comm_share"] <= 1.0
+  assert stats["comm_gb_per_step"] > 0

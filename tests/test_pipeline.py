@@ -34,6 +34,7 @@ def _pipelines(S=4, M=4, sequential=False):
                   sequential=sequential)
 
 
+@pytest.mark.quick
 def test_pipeline_matches_sequential():
   epl.init()
   mesh = epl.init().cluster.build_mesh(stage=4)
@@ -119,6 +120,7 @@ def test_pipeline_training_decreases_loss():
   assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_matches_gpt_sequential():
   from easyparallellibrary_tpu.models import GPT, GPTConfig
   from easyparallellibrary_tpu.models.gpt import gpt_loss

@@ -67,6 +67,7 @@ def _run_pair(S, K, M, L, **kw):
 
 @pytest.mark.parametrize("S,K,M,L", [(2, 2, 4, 8), (2, 3, 6, 6),
                                      (4, 2, 4, 8)])
+@pytest.mark.slow
 def test_interleaved_matches_sequential(S, K, M, L):
   l1, g1, l2, g2 = _run_pair(S, K, M, L)
   np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
@@ -78,6 +79,7 @@ def test_interleaved_matches_sequential(S, K, M, L):
       g1, g2)
 
 
+@pytest.mark.slow
 def test_interleaved_uneven_layers_match_sequential():
   """6 layers over 4 virtual chunks: masked slots are real branches per
   device-chunk and numerics still match."""

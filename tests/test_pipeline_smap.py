@@ -175,6 +175,7 @@ def test_smap_1f1b_matches_sequential(S, M):
       g1, g2)
 
 
+@pytest.mark.slow
 def test_smap_1f1b_uneven_stages():
   mesh, pp, base, ids, params = _setup(M=4, S=2, num_layers=5)
   seq = GPT(GPTConfig(**base, pipeline_debug_sequential=True))

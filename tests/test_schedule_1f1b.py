@@ -131,6 +131,7 @@ def test_gpt_1f1b_train_step_decreases_loss():
   assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_gpt_train_step_dispatch():
   """PreferForward -> autodiff path; PreferBackward -> 1F1B engine."""
   _, pp, base, ids, params = _gpt_setup()
@@ -150,6 +151,7 @@ def test_gpt_train_step_dispatch():
                              rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_1f1b_bounds_live_activations_vs_gpipe():
   """The VERDICT done-criterion: PreferBackward (1F1B) compiled temp bytes
   < PreferForward (GPipe, no remat) at M=8, S=4 — the schedule's
@@ -202,6 +204,7 @@ def test_stageblocks_mask_applies_exact_count():
                              rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_gpt_uneven_layers_pipeline_and_1f1b_match_sequential():
   """num_layers % stages != 0 trains: both the GPipe module path and the
   1F1B engine agree with the sequential ground truth (VERDICT item 5;
@@ -235,6 +238,7 @@ def test_gpt_uneven_layers_pipeline_and_1f1b_match_sequential():
       g1, g_seq)
 
 
+@pytest.mark.slow
 def test_1f1b_composes_amp_and_grouped_apply():
   """AMP loss scaling and PreferBackwardOptimizer's grouped apply compose
   around the 1F1B gradient path via build_train_step."""

@@ -35,6 +35,7 @@ def _seq_mesh(n=4):
   return epl.current_plan().build_mesh()
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_matches_full(causal):
   mesh = _seq_mesh(4)
@@ -93,6 +94,7 @@ def test_ulysses_head_divisibility():
     ulysses_attention(q, k, v)
 
 
+@pytest.mark.slow
 def test_gpt_with_ring_attention_matches_xla():
   from easyparallellibrary_tpu.models import GPT, GPTConfig
   env = epl.init(epl.Config({"sequence.parallelism": "ring",
@@ -176,6 +178,7 @@ def test_ring_block_size_config_finer_blocks():
   np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_ring_default_uses_flash_shard_map(monkeypatch):
   """With an active seq axis and no block-size override, ring dispatches
   to the shard_map + flash-kernel path (the design point)."""
@@ -196,6 +199,7 @@ def test_ring_default_uses_flash_shard_map(monkeypatch):
   assert called.get("flash")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_einsum_impl_matches_flash(causal):
   """The two ring implementations (global-array einsum vs shard_map +
@@ -271,6 +275,7 @@ def test_zigzag_ring_matches_full(n):
   np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_zigzag_ring_grads_match_full():
   epl.init(epl.Config({"sequence.parallelism": "ring",
                        "sequence.axis_size": 4,
@@ -331,6 +336,7 @@ def test_unblockable_lengths_fall_back_to_einsum():
                              rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_dense_ring_matches_full_attention_both_layouts():
   """`sequence.ring_impl="dense"` (plain-XLA blocks — the pallas-free
   fallback and the compiled measurement path for the layout benchmarks)

@@ -16,6 +16,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# Heavyweight engine-composition compiles (~8 min of XLA time): excluded
+# from the tier-1 window, still run by `pytest tests/test_smap_sequence.py`.
+pytestmark = pytest.mark.slow
+
 import easyparallellibrary_tpu as epl
 from easyparallellibrary_tpu.models import GPT, GPTConfig
 from easyparallellibrary_tpu.models.gpt import (

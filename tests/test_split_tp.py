@@ -92,6 +92,7 @@ def test_tp_kernel_is_sharded():
   assert leaf.sharding.shard_shape(leaf.shape)[1] == leaf.shape[1] // 8
 
 
+@pytest.mark.quick
 def test_tp_matches_unsharded():
   tp_losses, _ = _run(use_split=True)
   base_losses, _ = _run(use_split=False)

@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
@@ -50,6 +51,7 @@ def test_zero_v0_shards_opt_state_on_data_axis():
   assert all(s == P() for s in pspecs)
 
 
+@pytest.mark.quick
 def test_zero_training_matches_baseline():
   def run(zero_level):
     model, mesh, state, shardings, x = _build(zero_level)
@@ -315,3 +317,47 @@ def test_zero_v1_smap_interleaved_and_tp_match_baseline():
     return losses
 
   np.testing.assert_allclose(run("v1"), run(""), rtol=2e-5)
+
+
+def test_zero1_owner_dim_rule_shared_across_layouts():
+  """The engines' grad owner dims (pipeline_smap.zero1_grad_layout) and
+  the optimizer-state layout (runtime.zero._shard_leaf_spec) both
+  delegate to runtime.zero.zero_owner_dim — assert the chosen dims agree
+  on K=1 (stage-stacked), K>1 (stacked with the inserted '_chunk' axis)
+  and TP (model-sharded) trees, so scattered grads always land on the
+  owner's optimizer shard without a GSPMD reshard."""
+  import types
+  from easyparallellibrary_tpu.parallel.pipeline_smap import (
+      zero1_grad_layout)
+  from easyparallellibrary_tpu.runtime.zero import _shard_leaf_spec
+
+  dp = 4
+  leaf = lambda *s: types.SimpleNamespace(shape=s)  # noqa: E731
+  un = {
+      "k1": leaf(16, 8),          # K=1 stage-stacked trunk leaf
+      "k2": leaf(4, 2, 16, 8),    # K>1: chunk axis stacked at dim 1
+      "tp": leaf(16, 8),          # TP leaf: model axis on dim 1
+      "small": leaf(3, 2),        # nothing divisible -> replicated
+  }
+  full = {"k1": P("stage"), "k2": P("stage", "_chunk"),
+          "tp": P(None, "model"), "small": P()}
+  man = {"k1": P("stage"), "k2": P("stage"), "tp": P(), "small": P()}
+  dims, out_specs = zero1_grad_layout(un, full, man, dp)
+  assert dims == {"k1": 1, "k2": 2, "tp": 0, "small": -1}
+
+  # Agreement with the optimizer-state rule on the same leaves:
+  assert _shard_leaf_spec(leaf(16, 8), P("stage"), dp) == \
+      P("stage", "data")                          # dim 1 == dims["k1"]
+  assert _shard_leaf_spec(leaf(16, 8), P(None, "model"), dp) == \
+      P("data", "model")                          # dim 0 == dims["tp"]
+  # K>1: shard_opt_state sees the PER-PASS leaf [S, 16, 8]; the engine
+  # sees it stacked with a chunk axis inserted at dim 1, so the engine's
+  # dim must be the per-pass dim + 1.
+  per_pass = _shard_leaf_spec(leaf(4, 16, 8), P("stage"), dp)
+  per_pass_dim = list(per_pass).index("data")
+  assert dims["k2"] == per_pass_dim + 1
+  # Replicated leaves stay replicated under both rules.
+  assert _shard_leaf_spec(leaf(3, 2), P(), dp) == P()
+  # The owner spec adds `data` exactly at the chosen dim.
+  assert out_specs["k1"] == P("stage", "data")
+  assert out_specs["tp"] == P("data", None)
