@@ -11,7 +11,11 @@ test: build
 bench:
 	python bench.py
 
+# Fault-injection suite standalone (testing/chaos.py + docs/robustness.md).
+chaos:
+	python -m pytest tests/test_resilience.py -q
+
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test bench clean
+.PHONY: all build test bench chaos clean

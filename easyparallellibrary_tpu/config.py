@@ -308,6 +308,46 @@ class SequenceConfig(_Category):
   }
 
 
+class ResilienceConfig(_Category):
+  """Failure recovery — crash-consistent checkpoints, anomaly sentinel,
+  IO retry, step watchdog (docs/robustness.md).  New vs the reference,
+  whose recovery story is kill-and-retry (SURVEY §5.3)."""
+  _name = "resilience"
+  _fields = {
+      # Stage each checkpoint in a step_N.tmp dir with per-shard sha256
+      # checksums, fsync, then atomically rename to commit — a crash
+      # mid-save can never shadow the previous good checkpoint
+      # (CheckFreq-style crash consistency, Mohan et al. FAST'21).
+      "atomic_checkpoints": True,
+      # Retain only the newest N committed checkpoints (0 = keep all).
+      "keep_last": 0,
+      # In-jit anomaly sentinel: finite-check loss/grads every step and
+      # suppress the update via jnp.where on a bad step (no extra host
+      # sync); consecutive bad steps are counted on-device and surfaced
+      # as the `bad_steps` metric.  Implied on when max_bad_steps > 0.
+      "sentinel": False,
+      # After this many CONSECUTIVE non-finite steps, fit() rolls the
+      # training state back to the newest valid checkpoint (0 = never;
+      # skip-only).  The host checks the on-device counter once per
+      # max_bad_steps window, so the guard stays sync-free per step.
+      "max_bad_steps": 0,
+      # What to do when max_bad_steps trips: True = restore the last
+      # valid checkpoint and replay; False = raise (fail fast).
+      "rollback": True,
+      # Multiply the learning rate by this factor on each rollback
+      # (1.0 = off).  Requires the optimizer to expose its LR via
+      # optax.inject_hyperparams; logged and skipped otherwise.
+      "rollback_lr_backoff": 1.0,
+      # Transient-IO retries (checkpoint shard read/write, record-file
+      # open, data-iterator next) and the initial backoff between them.
+      "io_retries": 3,
+      "io_retry_backoff_s": 0.05,
+      # Log diagnostics when one fit() step (data fetch + dispatch)
+      # exceeds this wall-clock deadline (0 = off).
+      "step_timeout_s": 0.0,
+  }
+
+
 class Config:
   """Root configuration (reference: epl/config.py:181).
 
@@ -321,7 +361,7 @@ class Config:
   _categories: Tuple[type, ...] = (
       AutoParallelConfig, IOConfig, CommunicationConfig, PipelineConfig,
       GradientCheckpointConfig, ZeroConfig, OffloadConfig, AMPConfig,
-      ClusterConfig, OptimizerConfig, SequenceConfig,
+      ClusterConfig, OptimizerConfig, SequenceConfig, ResilienceConfig,
   )
 
   def __init__(self, param_dict: Dict[str, Any] | None = None):
@@ -417,6 +457,17 @@ class Config:
     if self.communication.overlap_chunks < 0:
       raise ValueError("communication.overlap_chunks must be >= 0; got "
                        f"{self.communication.overlap_chunks}")
+    for field in ("keep_last", "max_bad_steps", "io_retries"):
+      if getattr(self.resilience, field) < 0:
+        raise ValueError(f"resilience.{field} must be >= 0; got "
+                         f"{getattr(self.resilience, field)}")
+    for field in ("io_retry_backoff_s", "step_timeout_s"):
+      if getattr(self.resilience, field) < 0:
+        raise ValueError(f"resilience.{field} must be >= 0; got "
+                         f"{getattr(self.resilience, field)}")
+    if not 0 < self.resilience.rollback_lr_backoff <= 1:
+      raise ValueError("resilience.rollback_lr_backoff must be in (0, 1]; "
+                       f"got {self.resilience.rollback_lr_backoff}")
 
   def to_dict(self) -> Dict[str, Dict[str, Any]]:
     return {c._name: getattr(self, c._name).to_dict()

@@ -88,9 +88,14 @@ def write_records(path: str, records: Sequence[bytes],
 
 def _python_reader(files: List[str],
                    skip_records: int = 0) -> Iterator[bytes]:
+  from easyparallellibrary_tpu.utils.retry import retry_call
   skip = skip_records
   for fname in files:
-    with open(fname, "rb") as f:
+    # Record files live on network filesystems in production; the open is
+    # the transient-failure hot spot (resilience.io_retries bounds the
+    # retries, FileNotFoundError stays a hard error).
+    with retry_call(open, fname, "rb",
+                    what=f"record file open {fname}") as f:
       size = os.fstat(f.fileno()).st_size
       while True:
         header = f.read(8)

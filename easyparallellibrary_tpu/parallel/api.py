@@ -38,7 +38,13 @@ from easyparallellibrary_tpu.utils.logging import get_logger
 
 class TrainState(flax_train_state.TrainState):
   """Standard flax TrainState; kept as a named subclass so runtime
-  features (ZeRO, AMP loss scale) can extend it."""
+  features (ZeRO, AMP loss scale) can extend it.
+
+  `sentinel` (default None = off) holds the anomaly sentinel's on-device
+  counters (runtime/resilience.SentinelState) when the resilience guard
+  is active; as a None-default structural field it is invisible to every
+  path that doesn't opt in."""
+  sentinel: Any = None
 
 
 class MutableTrainState(TrainState):

@@ -149,9 +149,22 @@ class FlopsProfiler:
           DEFAULT_ICI_BYTES_PER_S)
       link_bytes_per_s = DEFAULT_ICI_BYTES_PER_S
     self.link_bytes_per_s = link_bytes_per_s
+    # Resilience counters (runtime/resilience.py): callers feed skipped
+    # non-finite steps and transient-IO retries here so the periodic
+    # stats line carries the health of the run, not just its speed.
+    self.bad_steps = 0
+    self.io_retries = 0
     self._t0 = None
     self._step0 = 0
     self._step = 0
+
+  def note_bad_step(self, n: int = 1):
+    """Count `n` anomaly-skipped steps into the next stats line."""
+    self.bad_steps += n
+
+  def note_retry(self, n: int = 1):
+    """Count `n` transient-IO retries into the next stats line."""
+    self.io_retries += n
 
   def measure_from(self, fn: Callable, *args, **kwargs):
     """Fill flops_per_step (and the comm counter) from XLA's cost model
@@ -186,5 +199,9 @@ class FlopsProfiler:
       # overlap policy's headroom indicator.
       stats["comm_share"] = min(
           self.comm_bytes_per_step / self.link_bytes_per_s / dt, 1.0)
+    if self.bad_steps:
+      stats["bad_steps"] = float(self.bad_steps)
+    if self.io_retries:
+      stats["io_retries"] = float(self.io_retries)
     get_logger().info("flops profiler: %s", stats)
     return stats

@@ -58,8 +58,19 @@ class StepProfiler:
     self.tokens_per_step = tokens_per_step
     self.warmup = warmup
     self.times = []
+    # Resilience counters: fed by fit() (runtime/loop.py) from the
+    # sentinel's on-device totals and the transient-IO retry count, so
+    # the end-of-run summary reports the health of the run too.
+    self.bad_steps = 0
+    self.io_retries = 0
     self._last = None
     self._count = 0
+
+  def note_bad_step(self, n: int = 1):
+    self.bad_steps += n
+
+  def note_retry(self, n: int = 1):
+    self.io_retries += n
 
   def tick(self):
     now = time.perf_counter()
@@ -77,6 +88,10 @@ class StepProfiler:
       out["tokens_per_sec"] = self.tokens_per_step / dt
     if self.flops_per_step:
       out["mfu"] = estimate_mfu(self.flops_per_step, dt)
+    if self.bad_steps:
+      out["bad_steps"] = float(self.bad_steps)
+    if self.io_retries:
+      out["io_retries"] = float(self.io_retries)
     return out
 
   @contextlib.contextmanager

@@ -113,11 +113,34 @@ def fixed_loss_scale(value: float) -> DynamicLossScale:
 
 
 def all_finite(tree) -> jnp.ndarray:
+  """Scalar bool: every floating leaf of `tree` is finite.  Shared by
+  the loss-scale skip and the resilience sentinel
+  (runtime/resilience.py) — one definition of "bad step" for both."""
   leaves = [jnp.all(jnp.isfinite(l)) for l in jax.tree_util.tree_leaves(tree)
             if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
   if not leaves:
     return jnp.bool_(True)
   return jnp.stack(leaves).all()
+
+
+def nonfinite_report(tree, max_entries: int = 8) -> "dict[str, int]":
+  """{path: nonfinite_count} for the offending leaves of a HOST tree —
+  the diagnostic logged when the sentinel escalates to a rollback, so
+  the log names which tensors went bad instead of just 'NaN somewhere'.
+  Forces a device sync; for post-mortem use, never the hot path."""
+  import numpy as np
+  from easyparallellibrary_tpu.utils.pytree import tree_paths_and_leaves
+  report = {}
+  for path, leaf in tree_paths_and_leaves(tree):
+    arr = np.asarray(jax.device_get(leaf))
+    if not np.issubdtype(arr.dtype, np.floating):
+      continue
+    bad = int(np.size(arr) - np.count_nonzero(np.isfinite(arr)))
+    if bad:
+      report[path] = bad
+      if len(report) >= max_entries:
+        break
+  return report
 
 
 def scaled_value_and_grad(loss_fn: Callable, scale: jnp.ndarray,

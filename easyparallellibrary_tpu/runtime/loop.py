@@ -19,9 +19,13 @@ from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax
 
+from easyparallellibrary_tpu.env import Env
 from easyparallellibrary_tpu.profiler.profiler import StepProfiler
+from easyparallellibrary_tpu.runtime import resilience as resilience_lib
 from easyparallellibrary_tpu.runtime import saver
 from easyparallellibrary_tpu.utils.logging import get_logger
+from easyparallellibrary_tpu.utils.retry import (
+    PERMANENT_IO_EXCEPTIONS, TRANSIENT_EXCEPTIONS)
 
 
 def _accepts_start_step(factory: Callable) -> bool:
@@ -70,6 +74,7 @@ def fit(step_fn: Callable,
   Returns (state, last_metrics).
   """
   log = get_logger()
+  res = Env.get().config.resilience
   rng = rng if rng is not None else jax.random.PRNGKey(0)
   start_step = int(state.step) if hasattr(state, "step") else 0
 
@@ -84,26 +89,67 @@ def fit(step_fn: Callable,
     return {"params": shardings.params, "opt_state": shardings.opt_state}
 
   if checkpoint_dir:
-    last = saver.latest_step(checkpoint_dir)
-    if last is not None and last > start_step:
-      log.info("resuming from %s at step %d", checkpoint_dir, last)
-      restored, _ = saver.restore_checkpoint(
+    # One validated restore pass (validation sha256-reads every shard —
+    # scanning via latest_step first would do all of that twice).  The
+    # rare waste is restoring a checkpoint no newer than the live state
+    # and discarding it.
+    try:
+      restored, rstep = saver.restore_checkpoint(
           checkpoint_dir, target=_ckpt_tree(state),
           shardings=_ckpt_shardings())
+    except saver.NoValidCheckpointError as e:
+      # Checkpoints exist but every one failed validation: silently
+      # retraining from step 0 would throw the whole run away.  This
+      # needs an operator (inspect the *.corrupt dirs, delete the root
+      # to really start over).
+      raise RuntimeError(
+          f"refusing to start fresh: {checkpoint_dir!r} contains "
+          f"checkpoints but none validate ({e})") from e
+    except FileNotFoundError as e:
+      if saver.has_quarantined(checkpoint_dir):
+        # Only *.corrupt dirs remain (e.g. a restart right after the
+        # refusal below quarantined everything): still not a fresh run.
+        raise RuntimeError(
+            f"refusing to start fresh: {checkpoint_dir!r} holds only "
+            f"quarantined (*.corrupt) checkpoints; inspect or clear "
+            f"them to really start over") from e
+      restored, rstep = None, None  # fresh run
+    if jax.process_count() > 1:
+      # Each process validated the chain independently; a transient read
+      # error on one host can make it fall back further than the others
+      # (or find nothing).  Silent divergence at the first collective is
+      # the worst outcome — compare the restored step against the
+      # leader's and fail loudly on mismatch.
+      import numpy as _np
+      from jax.experimental import multihost_utils
+      mine = -1 if rstep is None else int(rstep)
+      agreed = int(multihost_utils.broadcast_one_to_all(_np.int32(mine)))
+      if agreed != mine:
+        raise RuntimeError(
+            f"multi-host resume disagreement: leader restored step "
+            f"{agreed} but process {jax.process_index()} restored "
+            f"{mine} from {checkpoint_dir!r} — refusing to train on "
+            f"diverged states")
+    if restored is not None and rstep is not None \
+        and int(rstep) > start_step:
+      rstep = int(rstep)
+      log.info("resuming from %s at step %d", checkpoint_dir, rstep)
       state = state.replace(params=restored["params"],
-                            opt_state=restored["opt_state"], step=last)
-      start_step = last
+                            opt_state=restored["opt_state"], step=rstep)
+      start_step = rstep
 
   # Preemption handling (beyond the reference's kill-and-retry, SURVEY
   # §5.3): on SIGTERM, finish the in-flight step, checkpoint, and exit so
   # the scheduler can requeue and `fit` resumes from the checkpoint.
   preempted = {"flag": False}
   prev_handler = None
+  handler_installed = False
   if checkpoint_on_preemption and checkpoint_dir:
     def _on_sigterm(signum, frame):
       preempted["flag"] = True
     try:
       prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+      handler_installed = True
     except ValueError:  # not the main thread
       prev_handler = None
 
@@ -114,61 +160,229 @@ def fit(step_fn: Callable,
       return iter(data(start_step=at_step))
     return iter(data())
 
+  io_retries = {"n": 0}
+
+  def _next_with_retry(it):
+    """`next(it)` with transient-IO retry (resilience.io_retries).
+
+    StopIteration from a CLEAN first attempt propagates — that is the
+    epoch boundary.  StopIteration raised on a RETRY attempt means the
+    iterator was a generator that died on the original error (an
+    exhausted generator raises StopIteration forever after), so the
+    original exception is re-raised instead of being mistaken for an
+    epoch end and silently restarting the stream.
+    """
+    try:
+      return next(it)
+    except TRANSIENT_EXCEPTIONS as e:
+      if res.io_retries <= 0 or isinstance(e, PERMANENT_IO_EXCEPTIONS):
+        raise
+      delay, last = res.io_retry_backoff_s, e
+      for attempt in range(res.io_retries):
+        log.warning("transient data-iterator failure (attempt %d/%d): %s "
+                    "— retrying in %.2fs", attempt + 1,
+                    res.io_retries + 1, last, delay)
+        io_retries["n"] += 1
+        if delay:
+          time.sleep(delay)
+        delay = min(delay * 2 if delay else 0.0, 2.0)
+        try:
+          return next(it)
+        except StopIteration:
+          raise last from None
+        except TRANSIENT_EXCEPTIONS as e2:
+          if isinstance(e2, PERMANENT_IO_EXCEPTIONS):
+            raise  # deterministic error surfaced mid-retry: stop here
+          last = e2
+      raise last
+
+  watchdog = None
+  if res.step_timeout_s > 0:
+    watchdog = resilience_lib.StepWatchdog(res.step_timeout_s)
+
+  # Anomaly escalation: the sentinel counts consecutive bad steps
+  # ON-DEVICE (runtime/resilience.py); the host reads the counter once
+  # per max_bad_steps window — amortized, never per step, so the guard
+  # adds no per-step sync.  Past the threshold: roll back to the newest
+  # valid checkpoint (resilience.rollback) or fail fast.
+  check_every = res.max_bad_steps if res.max_bad_steps > 0 else 0
+  # `trigger` is the step index at which the last rollback fired: only
+  # surviving PAST that point counts as progress and resets the
+  # consecutive-rollback counter — a clean replayed prefix must not,
+  # or a deterministic fault far from the checkpoint would defeat the
+  # MAX_CONSECUTIVE_ROLLBACKS give-up and replay the same window forever.
+  rollbacks = {"total": 0, "consecutive": 0, "trigger": -1}
+  # Totals already forwarded to the profiler's note_bad_step/note_retry
+  # counters (both StepProfiler and FlopsProfiler expose them).
+  fed = {"bad": 0, "retries": 0}
+
+  def _rollback(state, bad, at_step):
+    log.error(
+        "anomaly sentinel: %d consecutive non-finite steps at step %d — "
+        "rolling back to the newest valid checkpoint", bad, at_step + 1)
+    from easyparallellibrary_tpu.runtime import amp as amp_lib
+    bad_params = amp_lib.nonfinite_report(state.params)
+    if bad_params:
+      # The jnp.where skip normally keeps params clean; non-finite live
+      # params here mean the poison predates the sentinel (or it was
+      # enabled mid-run) — name the tensors for the post-mortem.
+      log.error("non-finite live params at rollback: %s", bad_params)
+    if not checkpoint_dir:
+      raise RuntimeError(
+          "anomaly rollback requires checkpoint_dir; pass one to fit() "
+          "or set resilience.max_bad_steps=0")
+    try:
+      restored, rstep = saver.restore_checkpoint(
+          checkpoint_dir, target=_ckpt_tree(state),
+          shardings=_ckpt_shardings())
+    except FileNotFoundError as e:
+      raise RuntimeError(
+          f"anomaly rollback at step {at_step + 1} failed: no valid "
+          f"checkpoint under {checkpoint_dir!r}") from e
+    rstep = int(rstep) if rstep is not None else 0
+    state = state.replace(params=restored["params"],
+                          opt_state=restored["opt_state"], step=rstep)
+    if getattr(state, "sentinel", None) is not None:
+      state = state.replace(sentinel=resilience_lib.SentinelState.create())
+    if res.rollback_lr_backoff < 1.0:
+      # The restore just reset opt_state to the checkpoint's LR, so the
+      # factor must COMPOUND over consecutive rollbacks to the same
+      # checkpoint or repeat rollbacks would all run at the same LR.
+      factor = res.rollback_lr_backoff ** rollbacks["consecutive"]
+      new_opt, applied = resilience_lib.backoff_learning_rate(
+          state.opt_state, factor)
+      if applied:
+        state = state.replace(opt_state=new_opt)
+        log.warning("rollback: learning rate backed off by %.3g "
+                    "(rollback #%d since last progress)", factor,
+                    rollbacks["consecutive"])
+      else:
+        log.warning(
+            "resilience.rollback_lr_backoff=%.3g requested but the "
+            "optimizer state does not expose a learning_rate "
+            "hyperparameter (build it with optax.inject_hyperparams); "
+            "continuing without backoff", res.rollback_lr_backoff)
+    log.warning("rolled back to step %d; replaying", rstep)
+    return state
+
   it = _make_iter(start_step)
   metrics: Dict[str, Any] = {}
-  for step_idx in range(start_step, num_steps):
-    if preempted["flag"]:
-      log.warning("preemption signal received: checkpointing at step %d "
-                  "and exiting", step_idx)
-      saver.save_checkpoint(checkpoint_dir, _ckpt_tree(state),
-                            step=step_idx)
-      if prev_handler is not None:
-        signal.signal(signal.SIGTERM, prev_handler)
-      raise SystemExit(0)
-    try:
-      batch = next(it)
-    except StopIteration:
-      if step_idx == start_step and start_step > 0:
-        # The resumed stream produced nothing: almost always a
-        # skip_records that overran the shard (missing the modulo in the
-        # recipe above) — restarting at record 0 would silently train on
-        # a different data order than the uninterrupted run.
-        log.warning(
-            "data factory resumed at start_step=%d yielded no batches; "
-            "restarting the stream from its beginning.  If the factory "
-            "skips records, skip (start_step * records_per_step) MODULO "
-            "the shard's record count.", start_step)
-      # Epoch boundary: restart the stream from its beginning.
-      it = _make_iter(0)
+  step_idx = start_step
+  try:
+    while step_idx < num_steps:
+      if preempted["flag"]:
+        log.warning("preemption signal received: checkpointing at step %d "
+                    "and exiting", step_idx)
+        saver.save_checkpoint(checkpoint_dir, _ckpt_tree(state),
+                              step=step_idx)
+        raise SystemExit(0)
+      if watchdog is not None:
+        watchdog.arm(step_idx)
       try:
-        batch = next(it)
+        batch = _next_with_retry(it)
       except StopIteration:
-        raise RuntimeError(
-            "data iterator exhausted and could not be restarted; pass a "
-            "re-iterable (list) or a zero-arg iterator factory to fit() "
-            "for multi-epoch runs") from None
-    state, metrics = step_fn(state, batch,
-                             jax.random.fold_in(rng, step_idx))
-    if profiler is not None:
-      profiler.tick()
-    if metrics_writer is not None:
-      # Metrics arriving here are already merged global values
-      # (parallel/metrics.py) — the writer is a pure sink, matching the
-      # reference's summaries-over-merged-tensors contract
-      # (epl/parallel/hooks.py:593-664).  Writers buffer raw device
-      # values; construct them with flush_every=N so the host sync only
-      # happens every N steps and async dispatch survives.
-      metrics_writer.write(step_idx + 1, metrics)
-    if log_every and (step_idx + 1) % log_every == 0:
-      loss = metrics.get("loss")
-      log.info("step %d: loss %s", step_idx + 1,
-               f"{float(loss):.5f}" if loss is not None else "n/a")
-    if (checkpoint_dir and checkpoint_every
-        and (step_idx + 1) % checkpoint_every == 0):
-      saver.save_checkpoint(checkpoint_dir, _ckpt_tree(state),
-                            step=step_idx + 1)
-  if prev_handler is not None:
-    signal.signal(signal.SIGTERM, prev_handler)
+        if step_idx == start_step and start_step > 0:
+          # The resumed stream produced nothing: almost always a
+          # skip_records that overran the shard (missing the modulo in the
+          # recipe above) — restarting at record 0 would silently train on
+          # a different data order than the uninterrupted run.
+          log.warning(
+              "data factory resumed at start_step=%d yielded no batches; "
+              "restarting the stream from its beginning.  If the factory "
+              "skips records, skip (start_step * records_per_step) MODULO "
+              "the shard's record count.", start_step)
+        # Epoch boundary: restart the stream from its beginning.
+        it = _make_iter(0)
+        try:
+          batch = _next_with_retry(it)
+        except StopIteration:
+          raise RuntimeError(
+              "data iterator exhausted and could not be restarted; pass a "
+              "re-iterable (list) or a zero-arg iterator factory to fit() "
+              "for multi-epoch runs") from None
+      state, metrics = step_fn(state, batch,
+                               jax.random.fold_in(rng, step_idx))
+      if watchdog is not None:
+        watchdog.disarm()
+      if check_every and (step_idx + 1) % check_every == 0 \
+          and "bad_steps" in metrics:
+        bad = int(metrics["bad_steps"])  # one sync per window, amortized
+        if profiler is not None and hasattr(profiler, "note_bad_step") \
+            and "bad_steps_total" in metrics:
+          total_bad = int(metrics["bad_steps_total"])  # same sync window
+          if total_bad > fed["bad"]:
+            profiler.note_bad_step(total_bad - fed["bad"])
+          fed["bad"] = total_bad
+        if bad >= res.max_bad_steps:
+          if not res.rollback:
+            raise RuntimeError(
+                f"{bad} consecutive non-finite steps at step "
+                f"{step_idx + 1} (resilience.max_bad_steps="
+                f"{res.max_bad_steps}, rollback off)")
+          rollbacks["total"] += 1
+          rollbacks["consecutive"] += 1
+          if rollbacks["consecutive"] > \
+              resilience_lib.MAX_CONSECUTIVE_ROLLBACKS:
+            raise RuntimeError(
+                f"{rollbacks['consecutive']} rollbacks without a clean "
+                f"window in between — the anomaly is not transient; "
+                f"giving up at step {step_idx + 1}")
+          state = _rollback(state, bad, step_idx)
+          fed["bad"] = 0  # the sentinel counters were reset with the state
+          rollbacks["trigger"] = step_idx
+          step_idx = int(state.step)
+          it = _make_iter(step_idx)
+          continue  # the bad window is not checkpointed or logged
+        if step_idx > rollbacks["trigger"]:
+          rollbacks["consecutive"] = 0
+      if profiler is not None:
+        profiler.tick()
+        if hasattr(profiler, "note_retry") and io_retries["n"] > \
+            fed["retries"]:
+          profiler.note_retry(io_retries["n"] - fed["retries"])
+          fed["retries"] = io_retries["n"]
+      if metrics_writer is not None:
+        # Metrics arriving here are already merged global values
+        # (parallel/metrics.py) — the writer is a pure sink, matching the
+        # reference's summaries-over-merged-tensors contract
+        # (epl/parallel/hooks.py:593-664).  Writers buffer raw device
+        # values; construct them with flush_every=N so the host sync only
+        # happens every N steps and async dispatch survives.  Host-side
+        # resilience counters ride along when active.
+        out = metrics
+        if io_retries["n"] or rollbacks["total"]:
+          out = {**metrics, "io_retries": io_retries["n"],
+                 "rollbacks": rollbacks["total"]}
+        metrics_writer.write(step_idx + 1, out)
+      if log_every and (step_idx + 1) % log_every == 0:
+        loss = metrics.get("loss")
+        log.info("step %d: loss %s", step_idx + 1,
+                 f"{float(loss):.5f}" if loss is not None else "n/a")
+      if (checkpoint_dir and checkpoint_every
+          and (step_idx + 1) % checkpoint_every == 0):
+        saver.save_checkpoint(checkpoint_dir, _ckpt_tree(state),
+                              step=step_idx + 1)
+      step_idx += 1
+  except KeyboardInterrupt:
+    if checkpoint_on_preemption and checkpoint_dir:
+      log.warning("KeyboardInterrupt: saving final checkpoint at step %d",
+                  step_idx)
+      try:
+        saver.save_checkpoint(checkpoint_dir, _ckpt_tree(state),
+                              step=step_idx)
+      except Exception as e:
+        # An interrupt landing mid-step can leave donated buffers behind;
+        # a failed best-effort save must not mask the interrupt itself.
+        log.error("final checkpoint on interrupt failed: %s", e)
+    raise
+  finally:
+    # Restore the caller's SIGTERM disposition on EVERY exit path — an
+    # exception escaping step_fn must not leave fit's handler installed
+    # for the rest of the process.
+    if handler_installed and prev_handler is not None:
+      signal.signal(signal.SIGTERM, prev_handler)
+    if watchdog is not None:
+      watchdog.close()
   if profiler is not None and profiler.summary():
     log.info("training profile: %s", profiler.summary())
   return state, metrics

@@ -19,13 +19,28 @@ TPU-native analog of the reference's saver stack (epl/runtime/saver.py):
 An orbax-backed path is available for production multi-host async
 checkpointing (`use_orbax=True`); the native format keeps the framework
 dependency-free and transparent.
+
+Crash consistency (docs/robustness.md): each checkpoint is one
+``step_N`` directory under the checkpoint root.  The save stages into
+``step_N.tmp`` — shards with per-shard sha256 checksums recorded in the
+index, the index itself written via temp-file + ``os.replace``, all
+fsynced — then commits with an atomic directory rename, so a crash at
+ANY point leaves either the previous committed checkpoints untouched or
+a ``.tmp`` dir the chain scan ignores (CheckFreq-style semantics, Mohan
+et al. FAST'21).  ``restore_checkpoint``/``latest_step`` validate
+checksums and fall back down the chain to the newest VALID checkpoint,
+quarantining corrupt ones as ``step_N.corrupt``; ``keep_last`` bounds
+retention.  A directory containing ``index.json`` directly (the pre-
+chain flat layout) is still restored as a single checkpoint.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
+import shutil
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -38,6 +53,183 @@ from easyparallellibrary_tpu.utils.pytree import (
     path_str, tree_paths_and_leaves)
 
 INDEX_FILE = "index.json"
+TMP_SUFFIX = ".tmp"
+CORRUPT_SUFFIX = ".corrupt"
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+
+
+class NoValidCheckpointError(FileNotFoundError):
+  """Candidates existed but every one failed validation.  Distinct from
+  a plain FileNotFoundError (empty/missing directory — a fresh run) so
+  callers can fail loudly instead of silently restarting from step 0."""
+
+
+def _step_dir_name(step: int) -> str:
+  return f"step_{step:08d}"
+
+
+def _sha256_file(path: str) -> str:
+  h = hashlib.sha256()
+  with open(path, "rb") as f:
+    for chunk in iter(lambda: f.read(1 << 20), b""):
+      h.update(chunk)
+  return h.hexdigest()
+
+
+def _fsync_path(path: str, is_dir: bool = False):
+  """Best-effort fsync of a file or directory entry (directory fsync is
+  what makes the rename-commit durable on POSIX)."""
+  try:
+    fd = os.open(path, os.O_RDONLY | (os.O_DIRECTORY if is_dir else 0))
+  except (OSError, AttributeError):  # pragma: no cover - platform specific
+    return
+  try:
+    os.fsync(fd)
+  except OSError:  # pragma: no cover
+    pass
+  finally:
+    os.close(fd)
+
+
+def _write_index(directory: str, index: Dict[str, Any]):
+  """Write index.json via temp-file + atomic replace: a crash mid-write
+  can never leave a truncated JSON shadowing the shard files."""
+  final = os.path.join(directory, INDEX_FILE)
+  tmp = final + TMP_SUFFIX
+  with open(tmp, "w") as f:
+    json.dump(index, f, indent=1)
+    f.flush()
+    os.fsync(f.fileno())
+  os.replace(tmp, final)
+
+
+def _candidate_dirs(directory: str) -> List[str]:
+  """Checkpoint candidates, newest first.
+
+  ``step_N`` children form the fallback chain; staging (``.tmp``) and
+  quarantined (``.corrupt``) dirs are never candidates.  A directory
+  holding ``index.json`` itself is also a candidate — a committed step
+  dir, or the legacy flat layout.  A flat checkpoint can COEXIST with
+  step dirs (a pre-chain run upgraded and kept checkpointing into the
+  same root), so it is ranked into the chain by its recorded step, never
+  allowed to shadow newer step dirs.
+  """
+  try:
+    names = os.listdir(directory)
+  except (FileNotFoundError, NotADirectoryError):
+    return []
+  ranked: List[Tuple[int, str]] = []
+  for name in names:
+    m = _STEP_DIR_RE.match(name)
+    if m and os.path.isdir(os.path.join(directory, name)):
+      ranked.append((int(m.group(1)), os.path.join(directory, name)))
+  if INDEX_FILE in names:
+    if not ranked:
+      return [directory]
+    try:
+      with open(os.path.join(directory, INDEX_FILE)) as f:
+        flat_step = json.load(f).get("step")
+      flat_step = int(flat_step) if flat_step is not None else -1
+    except (OSError, ValueError, TypeError):
+      flat_step = -1  # unparsable: last resort in the chain
+    ranked.append((flat_step, directory))
+  return [p for _, p in sorted(ranked, key=lambda t: t[0], reverse=True)]
+
+
+def has_quarantined(directory: str) -> bool:
+  """Whether the checkpoint root holds quarantined (``*.corrupt``)
+  checkpoints — evidence that data WAS here and rotted, which callers
+  should surface before deciding to train from scratch."""
+  try:
+    return any(CORRUPT_SUFFIX in name for name in os.listdir(directory))
+  except (FileNotFoundError, NotADirectoryError):
+    return False
+
+
+def verify_checkpoint(path: str) -> Tuple[bool, str]:
+  """Validate one checkpoint dir: index parses, every shard exists, and
+  recorded sizes/sha256 checksums match.  Returns (ok, reason)."""
+  try:
+    with open(os.path.join(path, INDEX_FILE)) as f:
+      index = json.load(f)
+  except FileNotFoundError:
+    return False, "missing index.json"
+  except (json.JSONDecodeError, OSError, UnicodeDecodeError, ValueError) as e:
+    return False, f"unparsable index.json ({e})"
+  if not isinstance(index, dict) or "leaves" not in index:
+    return False, "malformed index.json (no leaves)"
+  try:
+    for entry in index.get("shards", []):
+      if isinstance(entry, str):  # pre-checksum index format
+        fname, nbytes, digest = entry, None, None
+      else:
+        fname = entry.get("file", "")
+        nbytes, digest = entry.get("bytes"), entry.get("sha256")
+      fpath = os.path.join(path, fname)
+      if not os.path.isfile(fpath):
+        return False, f"missing shard {fname}"
+      if nbytes is not None and os.path.getsize(fpath) != nbytes:
+        return False, (f"shard {fname}: size {os.path.getsize(fpath)} != "
+                       f"recorded {nbytes} (truncated?)")
+      if digest is not None:
+        # Retry transient read errors before declaring the shard bad — a
+        # network-filesystem blip must not get a VALID checkpoint
+        # quarantined (FileNotFoundError stays permanent: a vanished
+        # shard IS invalid).
+        from easyparallellibrary_tpu.utils.retry import retry_call
+        if retry_call(_sha256_file, fpath,
+                      what=f"checksum read {fname}") != digest:
+          return False, f"shard {fname}: sha256 mismatch (corrupted)"
+  except OSError as e:
+    # A shard vanishing mid-verify (another process quarantined or
+    # retention-pruned the dir under us) is just another way for the
+    # candidate to be invalid — the chain must fall back, not crash.
+    return False, f"shard disappeared during validation ({e})"
+  return True, ""
+
+
+def _quarantine(path: str):
+  """Rename a corrupt checkpoint dir out of the chain (leader only;
+  best-effort — a failed rename just leaves it to be skipped again)."""
+  if jax.process_index() != 0:
+    return
+  target = path + CORRUPT_SUFFIX
+  n = 0
+  while os.path.exists(target):
+    n += 1
+    target = f"{path}{CORRUPT_SUFFIX}.{n}"
+  try:
+    os.replace(path, target)
+    get_logger().warning("quarantined corrupt checkpoint %s -> %s",
+                         path, target)
+  except OSError as e:  # pragma: no cover - racing cleanup
+    get_logger().warning("could not quarantine %s: %s", path, e)
+
+
+def _apply_retention(directory: str, keep_last: int):
+  """Delete committed checkpoints beyond the newest `keep_last`, plus any
+  stale staging dirs a crashed save left behind (leader only)."""
+  if jax.process_index() != 0:
+    return
+  try:
+    names = os.listdir(directory)
+  except FileNotFoundError:
+    return
+  for name in names:
+    if name.endswith(TMP_SUFFIX) and _STEP_DIR_RE.match(
+        name[:-len(TMP_SUFFIX)]):
+      shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
+      get_logger().info("removed stale checkpoint staging dir %s", name)
+  if keep_last <= 0:
+    return
+  for path in _candidate_dirs(directory)[keep_last:]:
+    if path == directory:
+      # The root itself can be a (legacy flat) candidate — retention
+      # must never rmtree the checkpoint root out from under the chain.
+      continue
+    shutil.rmtree(path, ignore_errors=True)
+    get_logger().info("retention (keep_last=%d): removed %s",
+                      keep_last, path)
 
 
 def _unbox(tree):
@@ -81,27 +273,51 @@ def _rebox_like(template, tree):
 
 
 def save_checkpoint(directory: str, tree, step: Optional[int] = None,
-                    shard_mb: Optional[int] = None) -> str:
-  """Write `tree` under `directory` (leader process only).
+                    shard_mb: Optional[int] = None,
+                    keep_last: Optional[int] = None,
+                    atomic: Optional[bool] = None) -> str:
+  """Write `tree` as checkpoint ``directory/step_N`` (leader process
+  writes).
 
-  Returns the checkpoint path.  Leaves are fetched and written bucket by
-  bucket (≤ `shard_mb`, default 50 MB — reference saver.py:148) so host
-  memory stays bounded.
+  Returns the committed checkpoint path.  Leaves are fetched and written
+  bucket by bucket (≤ `shard_mb`, default 50 MB — reference saver.py:148)
+  so host memory stays bounded.  With `atomic` (default
+  ``resilience.atomic_checkpoints``) the whole checkpoint is staged in
+  ``step_N.tmp`` — per-shard sha256 checksums in the index, everything
+  fsynced — and committed by one directory rename, so a crash mid-save
+  never shadows an older valid checkpoint.  `keep_last` (default
+  ``resilience.keep_last``; 0 = keep all) prunes older committed
+  checkpoints after the commit.
 
   Multi-host: EVERY process must call this (arrays sharded across hosts
   are all-gathered collectively); only process 0 writes, and all
   processes synchronize before returning so a follow-up restore cannot
   race the write.
   """
+  from easyparallellibrary_tpu.env import Env
+  from easyparallellibrary_tpu.utils.retry import retry_call
+  res = Env.get().config.resilience
+  if atomic is None:
+    atomic = res.atomic_checkpoints
+  if keep_last is None:
+    keep_last = res.keep_last
   multihost = jax.process_count() > 1
   is_leader = jax.process_index() == 0
   shard_mb = shard_mb or constants.DEFAULT_SAVE_SHARD_MB
   limit = shard_mb * 1024 * 1024
+
+  step_num = 0 if step is None else int(step)
+  final_dir = os.path.join(directory, _step_dir_name(step_num))
+  write_dir = final_dir + TMP_SUFFIX if atomic else final_dir
   if is_leader:
     os.makedirs(directory, exist_ok=True)
+    if os.path.isdir(write_dir):
+      shutil.rmtree(write_dir)
+    os.makedirs(write_dir)
 
   flat = _boxed_paths_and_leaves(tree)
-  index: Dict[str, Any] = {"step": step, "leaves": {}, "shards": []}
+  index: Dict[str, Any] = {"step": step, "format": 2, "leaves": {},
+                           "shards": []}
   bucket: List[Tuple[str, Any]] = []
   bucket_bytes = 0
   shard_id = 0
@@ -137,8 +353,15 @@ def save_checkpoint(directory: str, tree, step: Optional[int] = None,
           "shard": fname, "shape": list(host.shape),
           "dtype": str(host.dtype)}
     if is_leader:
-      np.savez(os.path.join(directory, fname), **arrays)
-    index["shards"].append(fname)
+      fpath = os.path.join(write_dir, fname)
+      retry_call(lambda: np.savez(fpath, **arrays),
+                 what=f"checkpoint shard write {fname}")
+      _fsync_path(fpath)
+      # Checksum over the bytes actually on disk: what verification will
+      # re-read is exactly what was hashed.
+      index["shards"].append({"file": fname,
+                              "bytes": os.path.getsize(fpath),
+                              "sha256": _sha256_file(fpath)})
     shard_id += 1
     bucket, bucket_bytes = [], 0
 
@@ -156,14 +379,24 @@ def save_checkpoint(directory: str, tree, step: Optional[int] = None,
   flush()
 
   if is_leader:
-    with open(os.path.join(directory, INDEX_FILE), "w") as f:
-      json.dump(index, f, indent=1)
+    retry_call(lambda: _write_index(write_dir, index),
+               what="checkpoint index write")
+    _fsync_path(write_dir, is_dir=True)
+    if atomic:
+      # Commit: one atomic rename.  Everything inside is already fsynced,
+      # so after the parent-dir fsync the checkpoint either exists whole
+      # or not at all.
+      if os.path.isdir(final_dir):
+        shutil.rmtree(final_dir)
+      os.replace(write_dir, final_dir)
+    _fsync_path(directory, is_dir=True)
     get_logger().info("saved checkpoint: %s (%d leaves, %d shards)",
-                      directory, len(index["leaves"]), shard_id)
+                      final_dir, len(index["leaves"]), shard_id)
+    _apply_retention(directory, keep_last)
   if multihost:
     from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(f"epl_save_{directory}")
-  return directory
+    multihost_utils.sync_global_devices(f"epl_save_{directory}_{step_num}")
+  return final_dir
 
 
 def _apply_assign_map(path: str, assign_map: Optional[Dict[str, str]]
@@ -219,7 +452,14 @@ def restore_checkpoint(directory: str,
                        assign_map: Optional[Dict[str, str]] = None,
                        slice_offsets: Optional[Dict[str, Tuple[int, ...]]]
                        = None):
-  """Restore a checkpoint.
+  """Restore the newest VALID checkpoint under `directory`.
+
+  `directory` is either one checkpoint (contains ``index.json``) or a
+  checkpoint root (contains ``step_N`` dirs).  For a root, candidates
+  are checksum-verified newest-first; corrupt ones are quarantined with
+  a warning and the restore falls back down the chain — a crash or
+  bit-rot in the newest checkpoint costs at most ``checkpoint_every``
+  steps of progress, never the run.
 
   * `target` (optional) — a pytree giving structure/shapes; loaded values
     are sliced to each leaf's shape (resharding-at-load) and the result
@@ -228,7 +468,39 @@ def restore_checkpoint(directory: str,
     `device_put` onto them (the GSPMD reshard).
   * `assign_map` — {regex: replacement} applied to *target* paths to find
     the checkpoint name.
+
+  Returns ``(tree, step)`` with `step` taken from the checkpoint
+  actually restored (callers must not assume it is the newest on disk).
   """
+  candidates = _candidate_dirs(directory)
+  if not candidates:
+    raise FileNotFoundError(
+        f"no checkpoint found under {directory!r} (no index.json and no "
+        f"step_N subdirectories)")
+  log = get_logger()
+  for path in candidates:
+    ok, reason = verify_checkpoint(path)
+    if ok:
+      return _restore_from(path, target, shardings, assign_map,
+                           slice_offsets)
+    log.warning("checkpoint %s failed validation (%s); falling back to "
+                "the previous checkpoint", path, reason)
+    if path != directory:
+      _quarantine(path)
+  raise NoValidCheckpointError(
+      f"no VALID checkpoint under {directory!r}: all {len(candidates)} "
+      f"candidate(s) failed validation (corrupt ones quarantined as "
+      f"*{CORRUPT_SUFFIX})")
+
+
+def _restore_from(directory: str,
+                  target=None,
+                  shardings=None,
+                  assign_map: Optional[Dict[str, str]] = None,
+                  slice_offsets: Optional[Dict[str, Tuple[int, ...]]]
+                  = None):
+  """Restore one already-validated checkpoint directory."""
+  from easyparallellibrary_tpu.utils.retry import retry_call
   with open(os.path.join(directory, INDEX_FILE)) as f:
     index = json.load(f)
 
@@ -242,7 +514,9 @@ def restore_checkpoint(directory: str,
           f"available: {sorted(index['leaves'])[:8]}...")
     shard = info["shard"]
     if shard not in cache:
-      cache[shard] = np.load(os.path.join(directory, shard))
+      spath = os.path.join(directory, shard)
+      cache[shard] = retry_call(lambda: np.load(spath),
+                                what=f"checkpoint shard read {shard}")
     return cache[shard][ckpt_path]
 
   if target is None:
@@ -280,11 +554,27 @@ def restore_checkpoint(directory: str,
 
 
 def latest_step(directory: str) -> Optional[int]:
-  try:
-    with open(os.path.join(directory, INDEX_FILE)) as f:
-      return json.load(f).get("step")
-  except FileNotFoundError:
-    return None
+  """Step of the newest VALID checkpoint under `directory` (a checkpoint
+  root or a single checkpoint dir), or None.
+
+  Validation matches :func:`restore_checkpoint` — index parses and every
+  shard's size/sha256 checks out — so the step returned here is one the
+  restore will actually succeed on.  Corrupt/unparsable candidates are
+  logged, quarantined, and skipped instead of crashing the resume path.
+  """
+  log = get_logger()
+  for path in _candidate_dirs(directory):
+    ok, reason = verify_checkpoint(path)
+    if ok:
+      try:
+        with open(os.path.join(path, INDEX_FILE)) as f:
+          return json.load(f).get("step")
+      except (OSError, ValueError):  # pragma: no cover - raced deletion
+        continue
+    log.warning("skipping invalid checkpoint %s (%s)", path, reason)
+    if path != directory:
+      _quarantine(path)
+  return None
 
 
 # ----------------------------------------------------------------- orbax --

@@ -35,6 +35,7 @@ from easyparallellibrary_tpu.runtime import amp as amp_lib
 from easyparallellibrary_tpu.runtime.gradient_accumulation import (
     accumulate_gradients,
 )
+from easyparallellibrary_tpu.runtime import resilience as resilience_lib
 from easyparallellibrary_tpu.runtime.optimizer_helper import apply_grad_group
 
 
@@ -78,6 +79,14 @@ def build_train_step(loss_fn: Optional[Callable] = None,
   if num_apply_group is None:
     num_apply_group = cfg.optimizer.num_apply_group
 
+  def _apply(state, grads):
+    if num_apply_group > 1:
+      new_params, new_opt = apply_grad_group(
+          state.tx, state.params, grads, state.opt_state, num_apply_group)
+      return state.replace(step=state.step + 1, params=new_params,
+                           opt_state=new_opt)
+    return state.apply_gradients(grads=grads)
+
   def step(state, batch, rng):
     if grad_fn is not None:
       (loss, aux), grads = grad_fn(
@@ -92,37 +101,38 @@ def build_train_step(loss_fn: Optional[Callable] = None,
       g_fn = accumulate_gradients(g_fn, ga_steps)
       (loss, aux), grads = g_fn(state.params, batch, rng)
 
-    if scaled:
-      finite = amp_lib.all_finite(grads)
-      new_scale = state.loss_scale.update(finite)
-      # Run the update, then select the OLD state wholesale on overflow —
-      # a true no-op step (the reference conditionally skips the apply,
-      # loss_scale.py:44-51; applying zeroed grads would still run weight
-      # decay and advance optimizer moments).
-      if num_apply_group > 1:
-        new_params, new_opt = apply_grad_group(
-            state.tx, state.params, grads, state.opt_state, num_apply_group)
-        updated = state.replace(step=state.step + 1, params=new_params,
-                                opt_state=new_opt)
-      else:
-        updated = state.apply_gradients(grads=grads)
-      pick = lambda new, old: jax.tree_util.tree_map(
-          lambda a, b: jnp.where(finite, a, b), new, old)
-      state = state.replace(
-          step=jnp.where(finite, updated.step, state.step),
-          params=pick(updated.params, state.params),
-          opt_state=pick(updated.opt_state, state.opt_state),
-          loss_scale=new_scale)
-      metrics = {"loss": loss, "loss_scale": new_scale.scale,
+    # Whether the anomaly sentinel rides this step is a structural fact
+    # of the state (resilience.attach_sentinel / create_train_state), so
+    # the branch resolves at trace time — one compiled program either way.
+    sentinel_on = getattr(state, "sentinel", None) is not None
+    if scaled or sentinel_on:
+      grads_finite = amp_lib.all_finite(grads)
+      # The sentinel also screens the LOSS: under bf16 (no loss scale) a
+      # NaN can surface in the loss with grads masked finite, and that
+      # step must not advance the optimizer either.
+      finite = grads_finite & resilience_lib.finite_check(loss) \
+          if sentinel_on else grads_finite
+      # Run the update, then select the OLD state wholesale on a bad
+      # step — a true no-op (the reference conditionally skips the
+      # apply, loss_scale.py:44-51; applying zeroed grads would still
+      # run weight decay and advance optimizer moments).
+      updated = _apply(state, grads)
+      state = resilience_lib.select_state(finite, updated, state)
+      metrics = {"loss": loss,
                  "grads_finite": finite.astype(jnp.float32)}
+      if scaled:
+        # The dynamic scale keeps its own contract: backoff is keyed on
+        # gradient overflow alone (a NaN loss is the sentinel's call,
+        # not a reason to shrink the scale).
+        state = state.replace(
+            loss_scale=state.loss_scale.update(grads_finite))
+        metrics["loss_scale"] = state.loss_scale.scale
+      if sentinel_on:
+        sentinel = state.sentinel.update(finite)
+        state = state.replace(sentinel=sentinel)
+        metrics.update(resilience_lib.sentinel_metrics(sentinel, finite))
     else:
-      if num_apply_group > 1:
-        new_params, new_opt = apply_grad_group(
-            state.tx, state.params, grads, state.opt_state, num_apply_group)
-        state = state.replace(step=state.step + 1, params=new_params,
-                              opt_state=new_opt)
-      else:
-        state = state.apply_gradients(grads=grads)
+      state = _apply(state, grads)
       metrics = {"loss": loss}
     if aux:
       metrics.update(aux)
@@ -132,13 +142,16 @@ def build_train_step(loss_fn: Optional[Callable] = None,
 
 
 def create_train_state(apply_fn, params, tx, config=None):
-  """TrainState factory honoring the AMP config."""
+  """TrainState factory honoring the AMP and resilience configs."""
   cfg = config if config is not None else Env.get().config
+  extra = {}
+  if resilience_lib.sentinel_enabled(cfg):
+    extra["sentinel"] = resilience_lib.SentinelState.create()
   if cfg.amp.level and cfg.amp.loss_scale not in ("", "none", "0"):
     if cfg.amp.loss_scale == "dynamic":
       scale = amp_lib.DynamicLossScale.create()
     else:
       scale = amp_lib.fixed_loss_scale(float(cfg.amp.loss_scale))
     return AmpTrainState.create(apply_fn=apply_fn, params=params, tx=tx,
-                                loss_scale=scale)
-  return TrainState.create(apply_fn=apply_fn, params=params, tx=tx)
+                                loss_scale=scale, **extra)
+  return TrainState.create(apply_fn=apply_fn, params=params, tx=tx, **extra)

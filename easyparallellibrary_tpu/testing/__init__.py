@@ -1,0 +1,4 @@
+"""Test-support utilities, including the fault-injection harness
+(:mod:`easyparallellibrary_tpu.testing.chaos`)."""
+
+from easyparallellibrary_tpu.testing import chaos  # noqa: F401

@@ -1,0 +1,213 @@
+"""Fault-injection harness — the adversary the resilience layer is
+tested against.
+
+Every fault class the resilience subsystem claims to survive has an
+injector here, so `tests/test_resilience.py` (and `make chaos`) can
+exercise the real recovery paths instead of mocking them:
+
+* checkpoint corruption — :func:`corrupt_shard`, :func:`corrupt_index`
+  (bit-flip / truncate / delete, after the save committed);
+* numeric poison — :class:`NaNInjector` (NaN batches at chosen steps),
+  :func:`nan_batch`;
+* transient IO — :class:`FlakyIterator` (data `next()` raising
+  `IOError` N times before succeeding), :func:`flaky` (same for any
+  callable);
+* preemption — :class:`SigtermInjector` (deliver SIGTERM to the current
+  process mid-`fit`, from inside the data stream).
+
+These mutate real files and deliver real signals; none of them are
+imported by library code.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+# -------------------------------------------------- checkpoint corruption --
+
+
+def _shard_files(ckpt_dir: str) -> list:
+  names = sorted(f for f in os.listdir(ckpt_dir) if f.endswith(".npz"))
+  if not names:
+    raise FileNotFoundError(f"no shard files under {ckpt_dir}")
+  return names
+
+
+def corrupt_shard(ckpt_dir: str, shard: int = 0, mode: str = "flip",
+                  offset: int = -64) -> str:
+  """Damage one committed shard file.  `mode`:
+
+  * ``"flip"`` — XOR a byte at `offset` (bit-rot; size unchanged, so
+    only the checksum can catch it),
+  * ``"truncate"`` — drop the trailing half (crash mid-write on a
+    non-atomic filesystem),
+  * ``"delete"`` — remove the file.
+
+  Returns the path of the damaged shard.
+  """
+  path = os.path.join(ckpt_dir, _shard_files(ckpt_dir)[shard])
+  if mode == "delete":
+    os.remove(path)
+    return path
+  size = os.path.getsize(path)
+  if mode == "truncate":
+    with open(path, "r+b") as f:
+      f.truncate(max(1, size // 2))
+    return path
+  if mode == "flip":
+    pos = offset % size
+    with open(path, "r+b") as f:
+      f.seek(pos)
+      byte = f.read(1)
+      f.seek(pos)
+      f.write(bytes([byte[0] ^ 0xFF]))
+    return path
+  raise ValueError(f"unknown corruption mode {mode!r}")
+
+
+def corrupt_index(ckpt_dir: str, mode: str = "truncate") -> str:
+  """Damage a checkpoint's ``index.json``: ``"truncate"`` (the classic
+  crash-mid-write artifact), ``"garbage"`` (unparsable bytes), or
+  ``"delete"``."""
+  path = os.path.join(ckpt_dir, "index.json")
+  if mode == "delete":
+    os.remove(path)
+  elif mode == "truncate":
+    with open(path, "r+b") as f:
+      f.truncate(max(1, os.path.getsize(path) // 3))
+  elif mode == "garbage":
+    with open(path, "wb") as f:
+      f.write(b"\x00not json\xff")
+  else:
+    raise ValueError(f"unknown corruption mode {mode!r}")
+  return path
+
+
+# ------------------------------------------------------- numeric poison --
+
+
+def nan_batch(batch):
+  """A copy of `batch` with every floating leaf fully NaN."""
+  def poison(x):
+    arr = np.asarray(x)
+    if np.issubdtype(arr.dtype, np.floating):
+      return np.full_like(arr, np.nan)
+    return x
+  return jax.tree_util.tree_map(poison, batch)
+
+
+class NaNInjector:
+  """Wrap a per-step batch source, poisoning chosen steps with NaNs.
+
+  ``batch_fn(step) -> batch`` provides the clean stream; steps listed in
+  `bad_steps` come out poisoned.  With ``once=True`` (default) each bad
+  step is poisoned only the FIRST time it is drawn — a replay after a
+  rollback sees clean data, modeling a transient corruption upstream.
+  Use as a `fit` data factory: it accepts ``start_step`` so resume and
+  rollback replays line the stream up with the step index.
+  """
+
+  def __init__(self, batch_fn: Callable[[int], Any],
+               bad_steps: Sequence[int], num_steps: int,
+               once: bool = True):
+    self.batch_fn = batch_fn
+    self.bad_steps = set(bad_steps)
+    self.num_steps = num_steps
+    self.once = once
+    self.poisoned: list = []
+
+  def __call__(self, start_step: int = 0) -> Iterator[Any]:
+    def gen():
+      for step in range(start_step, self.num_steps):
+        batch = self.batch_fn(step)
+        if step in self.bad_steps:
+          if self.once:
+            self.bad_steps.discard(step)
+          self.poisoned.append(step)
+          batch = nan_batch(batch)
+        yield batch
+    return gen()
+
+
+# -------------------------------------------------------- transient IO --
+
+
+class FlakyIterator:
+  """Iterator raising a transient exception `failures` times at position
+  `fail_at` before yielding that element — the data-side fault
+  `fit`'s retrying `next()` must absorb."""
+
+  def __init__(self, items: Iterable[Any], fail_at: int = 0,
+               failures: int = 1,
+               exc_factory: Callable[[], BaseException] = lambda:
+               IOError("chaos: transient read failure")):
+    self._items = list(items)
+    self.fail_at = fail_at
+    self.failures_left = failures
+    self.exc_factory = exc_factory
+    self._pos = 0
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    if self._pos >= len(self._items):
+      raise StopIteration
+    if self._pos == self.fail_at and self.failures_left > 0:
+      self.failures_left -= 1
+      raise self.exc_factory()
+    item = self._items[self._pos]
+    self._pos += 1
+    return item
+
+
+def flaky(fn: Callable, failures: int = 1,
+          exc_factory: Callable[[], BaseException] = lambda:
+          IOError("chaos: transient failure")) -> Callable:
+  """Wrap `fn` to raise a transient exception on its first `failures`
+  calls, then behave normally — for driving utils/retry paths."""
+  state = {"left": failures}
+
+  def wrapped(*args, **kwargs):
+    if state["left"] > 0:
+      state["left"] -= 1
+      raise exc_factory()
+    return fn(*args, **kwargs)
+
+  wrapped.chaos_state = state
+  return wrapped
+
+
+# ---------------------------------------------------------- preemption --
+
+
+class SigtermInjector:
+  """Iterable delivering SIGTERM to the current process when batch
+  `at_batch` (0-based) is drawn, then continuing to yield — so `fit`
+  observes the preemption flag on its next loop iteration, finishes the
+  in-flight step, checkpoints, and exits, exactly like a scheduler
+  preemption."""
+
+  def __init__(self, batch: Any, at_batch: int = 3,
+               max_batches: int = 10_000):
+    self.batch = batch
+    self.at_batch = at_batch
+    self.max_batches = max_batches
+    self._drawn = 0
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    if self._drawn >= self.max_batches:
+      raise StopIteration
+    if self._drawn == self.at_batch:
+      os.kill(os.getpid(), _signal.SIGTERM)
+    self._drawn += 1
+    return self.batch

@@ -1,0 +1,607 @@
+"""Resilience layer under injected faults (testing/chaos.py): crash-
+consistent checkpoints with checksum fallback, the anomaly sentinel's
+skip/rollback, IO retry, the step watchdog, and preemption round-trip
+exactness.  `make chaos` runs this suite standalone."""
+
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu import ops
+from easyparallellibrary_tpu.parallel import (
+    TrainState, create_sharded_train_state, make_train_step, parallelize)
+from easyparallellibrary_tpu.runtime import resilience, saver
+from easyparallellibrary_tpu.runtime.loop import fit
+from easyparallellibrary_tpu.testing import chaos
+from easyparallellibrary_tpu.utils.retry import retry_call
+
+
+class Net(nn.Module):
+  @nn.compact
+  def __call__(self, x):
+    return ops.Dense(1, parallel="none")(jnp.tanh(
+        ops.Dense(8, parallel="none")(x)))
+
+
+def _batch(seed=0):
+  r = np.random.RandomState(seed)
+  return {"x": jnp.asarray(r.randn(16, 4), jnp.float32),
+          "y": jnp.asarray(r.randn(16, 1), jnp.float32)}
+
+
+def _setup(config=None, sentinel=False):
+  env = epl.init(config)
+  mesh = epl.current_plan().build_mesh()
+  model = Net()
+  batch = _batch()
+
+  def init_fn(rng):
+    st = TrainState.create(apply_fn=model.apply,
+                           params=model.init(rng, batch["x"])["params"],
+                           tx=optax.adam(1e-2))
+    return resilience.attach_sentinel(st) if sentinel else st
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+
+  def loss_fn(params, b, rng):
+    pred = model.apply({"params": params}, b["x"])
+    return jnp.mean((pred - b["y"]) ** 2), {}
+
+  step = make_train_step(loss_fn)
+  if sentinel:
+    step = resilience.guard_step(step)
+  step = parallelize(step, mesh, shardings)
+  return state, shardings, step, batch
+
+
+# --------------------------------------------- crash-consistent saver --
+
+
+def test_atomic_commit_layout_and_checksums(tmp_path):
+  state, _, _, _ = _setup()
+  root = str(tmp_path / "ck")
+  path = saver.save_checkpoint(root, state.params, step=7)
+  assert os.path.basename(path) == "step_00000007"
+  assert not [d for d in os.listdir(root) if d.endswith(".tmp")]
+  index = json.load(open(os.path.join(path, "index.json")))
+  assert index["shards"] and all(
+      set(e) >= {"file", "bytes", "sha256"} for e in index["shards"])
+  ok, reason = saver.verify_checkpoint(path)
+  assert ok, reason
+
+
+def test_corrupt_newest_falls_back_and_quarantines(tmp_path):
+  state, shardings, step, batch = _setup()
+  root = str(tmp_path / "ck")
+  p5 = saver.save_checkpoint(root, state.params, step=5)
+  params5 = jax.tree_util.tree_map(np.asarray, nn.unbox(state.params))
+  state, _ = step(state, batch, jax.random.PRNGKey(1))
+  p9 = saver.save_checkpoint(root, state.params, step=9)
+  # Bit-flip (size-preserving): only the checksum can catch this.
+  chaos.corrupt_shard(p9, mode="flip")
+  assert saver.latest_step(root) == 5
+  # p9 was quarantined out of the chain by the scan above.
+  assert not os.path.isdir(p9)
+  assert any(d.endswith(".corrupt") for d in os.listdir(root))
+  restored, rstep = saver.restore_checkpoint(root, target=state.params)
+  assert rstep == 5
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+      nn.unbox(restored), params5)
+
+
+def test_truncated_shard_detected_by_size(tmp_path):
+  state, _, _, _ = _setup()
+  root = str(tmp_path / "ck")
+  saver.save_checkpoint(root, state.params, step=3)
+  p6 = saver.save_checkpoint(root, state.params, step=6)
+  chaos.corrupt_shard(p6, mode="truncate")
+  ok, reason = saver.verify_checkpoint(p6)
+  assert not ok and "size" in reason
+  assert saver.latest_step(root) == 3
+
+
+def test_truncated_or_missing_index_skipped(tmp_path):
+  state, _, _, _ = _setup()
+  root = str(tmp_path / "ck")
+  saver.save_checkpoint(root, state.params, step=2)
+  p4 = saver.save_checkpoint(root, state.params, step=4)
+  p8 = saver.save_checkpoint(root, state.params, step=8)
+  chaos.corrupt_index(p8, mode="truncate")
+  chaos.corrupt_index(p4, mode="delete")
+  assert saver.latest_step(root) == 2
+  restored, rstep = saver.restore_checkpoint(root, target=state.params)
+  assert rstep == 2
+
+
+def test_all_candidates_corrupt_raises_clearly(tmp_path):
+  state, _, _, _ = _setup()
+  root = str(tmp_path / "ck")
+  p1 = saver.save_checkpoint(root, state.params, step=1)
+  chaos.corrupt_index(p1, mode="garbage")
+  with pytest.raises(FileNotFoundError, match="VALID"):
+    saver.restore_checkpoint(root, target=state.params)
+  assert saver.latest_step(root) is None
+
+
+def test_keep_last_retention(tmp_path):
+  state, _, _, _ = _setup()
+  root = str(tmp_path / "ck")
+  for s in (1, 2, 3, 4, 5):
+    saver.save_checkpoint(root, state.params, step=s, keep_last=2)
+  steps = sorted(d for d in os.listdir(root) if d.startswith("step_"))
+  assert steps == ["step_00000004", "step_00000005"]
+  assert saver.latest_step(root) == 5
+
+
+def test_stale_staging_dir_cleaned_and_ignored(tmp_path):
+  state, _, _, _ = _setup()
+  root = str(tmp_path / "ck")
+  saver.save_checkpoint(root, state.params, step=1)
+  # Fake a crash mid-save: a staging dir that never committed.
+  os.makedirs(os.path.join(root, "step_00000002.tmp"))
+  assert saver.latest_step(root) == 1       # .tmp is never a candidate
+  saver.save_checkpoint(root, state.params, step=3)
+  assert not [d for d in os.listdir(root) if d.endswith(".tmp")]
+
+
+def test_legacy_flat_layout_still_restores(tmp_path):
+  import shutil
+  state, _, _, _ = _setup()
+  root = str(tmp_path / "ck")
+  path = saver.save_checkpoint(root, state.params, step=5)
+  flat = str(tmp_path / "flat")
+  os.makedirs(flat)
+  for f in os.listdir(path):
+    shutil.copy(os.path.join(path, f), os.path.join(flat, f))
+  assert saver.latest_step(flat) == 5
+  restored, rstep = saver.restore_checkpoint(flat, target=state.params)
+  assert rstep == 5
+  jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_array_equal(
+          np.asarray(a), np.asarray(b)),
+      nn.unbox(restored), nn.unbox(state.params))
+
+
+def test_flat_legacy_coexists_with_step_dirs(tmp_path):
+  """Upgrade path: a pre-chain FLAT checkpoint in the root must not
+  shadow newer step_N checkpoints saved beside it — and it stays in the
+  chain as the last-resort fallback."""
+  import shutil
+  state, _, _, _ = _setup()
+  root = str(tmp_path / "ck")
+  src = saver.save_checkpoint(root, state.params, step=3)
+  for f in os.listdir(src):  # fake the legacy layout: files in the root
+    shutil.copy(os.path.join(src, f), os.path.join(root, f))
+  shutil.rmtree(src)
+  assert saver.latest_step(root) == 3       # flat-only: still restores
+  p5 = saver.save_checkpoint(root, state.params, step=5)
+  assert saver.latest_step(root) == 5       # newer step dir wins
+  chaos.corrupt_shard(p5, mode="flip")
+  restored, rstep = saver.restore_checkpoint(root, target=state.params)
+  assert rstep == 3                         # …and the flat one catches us
+
+
+def test_fit_feeds_profiler_resilience_counters():
+  from easyparallellibrary_tpu.profiler.profiler import StepProfiler
+  state, shardings, step, batch = _setup()
+  prof = StepProfiler(warmup=0)
+  data = chaos.FlakyIterator([batch] * 4, fail_at=1, failures=2)
+  state, _ = fit(step, state, data, num_steps=4, log_every=0,
+                 profiler=prof)
+  assert prof.io_retries == 2
+  assert prof.summary().get("io_retries") == 2.0
+
+
+def test_non_atomic_mode_still_validates(tmp_path):
+  state, _, _, _ = _setup()
+  root = str(tmp_path / "ck")
+  path = saver.save_checkpoint(root, state.params, step=2, atomic=False)
+  assert os.path.basename(path) == "step_00000002"
+  ok, reason = saver.verify_checkpoint(path)
+  assert ok, reason
+
+
+# ------------------------------------------------------------- retry --
+
+
+def test_retry_call_recovers_transient_and_respects_permanent():
+  calls = chaos.flaky(lambda: "ok", failures=2)
+  assert retry_call(calls, retries=3, backoff_s=0.0) == "ok"
+
+  fails = chaos.flaky(lambda: "ok", failures=5)
+  with pytest.raises(IOError):
+    retry_call(fails, retries=2, backoff_s=0.0)
+
+  # FileNotFoundError is deterministic — no retries burned on it.
+  attempts = {"n": 0}
+
+  def missing():
+    attempts["n"] += 1
+    raise FileNotFoundError("gone")
+
+  with pytest.raises(FileNotFoundError):
+    retry_call(missing, retries=3, backoff_s=0.0)
+  assert attempts["n"] == 1
+
+
+def test_fit_retries_transient_data_error(tmp_path):
+  state, shardings, step, batch = _setup()
+  data = chaos.FlakyIterator([batch] * 5, fail_at=2, failures=2)
+  from easyparallellibrary_tpu.utils.metrics_writer import MetricsWriter
+  path = str(tmp_path / "m.jsonl")
+  with MetricsWriter(path) as w:
+    state, _ = fit(step, state, data, num_steps=5, log_every=0,
+                   metrics_writer=w)
+  assert int(state.step) == 5
+  lines = [json.loads(l) for l in open(path)]
+  assert lines[-1]["io_retries"] == 2
+
+
+def test_fit_exhausted_retries_reraises():
+  state, shardings, step, batch = _setup()
+  data = chaos.FlakyIterator([batch] * 5, fail_at=1, failures=99)
+  with pytest.raises(IOError):
+    fit(step, state, data, num_steps=5, log_every=0)
+
+
+def test_flops_profiler_surfaces_resilience_counters():
+  from easyparallellibrary_tpu.profiler.flops import FlopsProfiler
+  prof = FlopsProfiler(flops_per_step=1e9, every_n_steps=2)
+  prof.note_bad_step()
+  prof.note_retry(3)
+  stats = None
+  for _ in range(3):
+    stats = prof.step() or stats
+  assert stats is not None
+  assert stats["bad_steps"] == 1.0 and stats["io_retries"] == 3.0
+
+
+# ---------------------------------------------------- anomaly sentinel --
+
+
+def test_sentinel_skips_nan_update_exactly(tmp_path):
+  """A NaN batch at step K is a true no-op: the trajectory afterwards is
+  bit-identical to a run that never saw the bad batch."""
+  b1, b3 = _batch(1), _batch(3)
+  state, shardings, step, _ = _setup(sentinel=True)
+  bad = chaos.nan_batch(b1)
+  state, metrics = fit(step, state, [b1, bad, b3], num_steps=3,
+                       log_every=0)
+  assert int(state.step) == 2              # the poisoned step didn't count
+  assert int(metrics["bad_steps"]) == 0    # last step was clean
+  assert int(metrics["bad_steps_total"]) == 1
+  poisoned = jax.tree_util.tree_map(np.asarray,
+                                    jax.device_get(nn.unbox(state.params)))
+
+  state2, _, step2, _ = _setup(sentinel=True)
+  state2, _ = fit(step2, state2, [b1, b3], num_steps=2, log_every=0)
+  clean = jax.tree_util.tree_map(np.asarray,
+                                 jax.device_get(nn.unbox(state2.params)))
+  jax.tree_util.tree_map(np.testing.assert_array_equal, poisoned, clean)
+
+
+def test_sentinel_metrics_reach_writer(tmp_path):
+  from easyparallellibrary_tpu.utils.metrics_writer import MetricsWriter
+  state, shardings, step, batch = _setup(sentinel=True)
+  path = str(tmp_path / "m.jsonl")
+  with MetricsWriter(path) as w:
+    fit(step, state, [batch, chaos.nan_batch(batch), batch], num_steps=3,
+        log_every=0, metrics_writer=w)
+  lines = [json.loads(l) for l in open(path)]
+  assert [l["bad_steps"] for l in lines] == [0.0, 1.0, 0.0]
+  assert lines[-1]["bad_steps_total"] == 1.0
+  assert [l["update_skipped"] for l in lines] == [0.0, 1.0, 0.0]
+
+
+def test_sentinel_single_program_zero_host_sync():
+  """Acceptance: the guard lives inside the ONE jitted step — no second
+  compiled program, and no device->host transfer per step."""
+  state, shardings, step, batch = _setup(sentinel=True)
+  state, _ = step(state, batch, jax.random.PRNGKey(0))  # compile
+  with jax.transfer_guard_device_to_host("disallow"):
+    for i in range(5):
+      state, metrics = step(state, batch, jax.random.PRNGKey(i))
+  assert step.jitted._cache_size() == 1
+  assert int(state.step) == 6
+
+
+def test_trainer_sentinel_composes_with_amp_loss_scale():
+  """fp16 AMP + sentinel: DynamicLossScale keeps the scale semantics,
+  the sentinel contributes the counters — one step function."""
+  from easyparallellibrary_tpu.runtime.trainer import (
+      build_train_step, create_train_state)
+  env = epl.init(epl.Config({
+      "amp": {"level": "O1", "compute_dtype": "fp16",
+              "loss_scale": "dynamic"},
+      "resilience": {"sentinel": True}}))
+  model = Net()
+  batch = _batch()
+  params = model.init(jax.random.PRNGKey(0), batch["x"])["params"]
+
+  def loss_fn(p, b, rng):
+    pred = model.apply({"params": p}, b["x"])
+    return jnp.mean((pred - b["y"]) ** 2), {}
+
+  state = create_train_state(model.apply, params, optax.adam(1e-2))
+  assert state.sentinel is not None
+  step = jax.jit(build_train_step(loss_fn))
+  state, m = step(state, batch, jax.random.PRNGKey(1))
+  assert int(m["bad_steps"]) == 0 and "loss_scale" in m
+  state, m = step(state, chaos.nan_batch(batch), jax.random.PRNGKey(2))
+  assert int(m["bad_steps"]) == 1
+  assert float(m["update_skipped"]) == 1.0
+  state, m = step(state, batch, jax.random.PRNGKey(3))
+  assert int(m["bad_steps"]) == 0 and int(m["bad_steps_total"]) == 1
+  assert np.isfinite(
+      np.asarray(jax.tree_util.tree_leaves(state.params)[0])).all()
+
+
+def test_rollback_recovers_from_persistent_nans(tmp_path):
+  """Steps 4..6 are poisoned on first encounter; max_bad_steps=2 trips
+  the sentinel, fit rolls back to the step-4 checkpoint, replays (clean
+  this time), and finishes the run."""
+  cfg = epl.Config({"resilience": {"max_bad_steps": 2}})
+  state, shardings, step, batch = _setup(cfg, sentinel=True)
+  ckpt = str(tmp_path / "ck")
+  starts = []
+
+  injector = chaos.NaNInjector(lambda s: _batch(s), bad_steps=(4, 5, 6),
+                               num_steps=8)
+
+  def factory(start_step=0):
+    starts.append(start_step)
+    return injector(start_step)
+
+  state, metrics = fit(step, state, factory, num_steps=8,
+                       checkpoint_dir=ckpt, checkpoint_every=4,
+                       log_every=0, shardings=shardings)
+  # Poisoned steps 4 and 5 tripped max_bad_steps=2 -> rollback to the
+  # step-4 checkpoint; the replay sees clean data for 4 and 5 but step 6
+  # is poisoned on ITS first encounter and gets skipped (one suppressed
+  # update), so the state advances 7 times over 8 loop steps.
+  assert int(state.step) == 7
+  assert injector.poisoned == [4, 5, 6]     # faults really happened
+  assert starts == [0, 4]                   # stream rewound to the rollback
+  assert saver.latest_step(ckpt) == 8
+  params = jax.tree_util.tree_leaves(jax.device_get(state.params))
+  assert all(np.isfinite(np.asarray(p)).all() for p in params)
+
+
+def test_rollback_off_fails_fast(tmp_path):
+  cfg = epl.Config({"resilience": {"max_bad_steps": 2, "rollback": False}})
+  state, shardings, step, batch = _setup(cfg, sentinel=True)
+  data = [batch, batch, chaos.nan_batch(batch), chaos.nan_batch(batch),
+          batch, batch]
+  with pytest.raises(RuntimeError, match="non-finite"):
+    fit(step, state, data, num_steps=6, log_every=0,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        shardings=shardings)
+
+
+def test_persistent_fault_gives_up_after_rollback_cap(tmp_path):
+  """A DETERMINISTIC fault (same step poisoned on every replay) must hit
+  the consecutive-rollback cap and raise, not replay forever — and a
+  clean replayed prefix must not reset the cap."""
+  cfg = epl.Config({"resilience": {"max_bad_steps": 2}})
+  state, shardings, step, batch = _setup(cfg, sentinel=True)
+  ckpt = str(tmp_path / "ck")
+
+  # Poison every draw of steps >= 4, on every replay (once=False).
+  injector = chaos.NaNInjector(lambda s: _batch(s), bad_steps=(4, 5, 6, 7),
+                               num_steps=8, once=False)
+  with pytest.raises(RuntimeError, match="not transient"):
+    fit(step, state, lambda start_step=0: injector(start_step),
+        num_steps=8, checkpoint_dir=ckpt, checkpoint_every=4,
+        log_every=0, shardings=shardings)
+  # 1 initial + MAX_CONSECUTIVE_ROLLBACKS replays of the same window.
+  replays = injector.poisoned.count(4)
+  assert replays == resilience.MAX_CONSECUTIVE_ROLLBACKS + 1
+
+
+def test_fit_refuses_fresh_start_over_corrupt_checkpoints(tmp_path):
+  """All-corrupt checkpoint dir: resuming must raise, not silently
+  retrain from step 0 — and a root holding only quarantined dirs (after
+  a restart) must refuse too."""
+  state, shardings, step, batch = _setup()
+  root = str(tmp_path / "ck")
+  p1 = saver.save_checkpoint(root, state.params, step=1)
+  chaos.corrupt_index(p1, mode="garbage")
+  with pytest.raises(RuntimeError, match="refusing to start fresh"):
+    fit(step, state, [batch], num_steps=3, log_every=0,
+        checkpoint_dir=root, shardings=shardings)
+  # The refusal quarantined the candidate; a restart still refuses.
+  assert saver.has_quarantined(root)
+  state2, shardings2, step2, _ = _setup()
+  with pytest.raises(RuntimeError, match="refusing to start fresh"):
+    fit(step2, state2, [batch], num_steps=3, log_every=0,
+        checkpoint_dir=root, shardings=shardings2)
+
+
+def test_fit_permanent_error_mid_retry_not_retried():
+  state, shardings, step, batch = _setup()
+  errors = [IOError("transient blip"), FileNotFoundError("really gone")]
+
+  class Flaky2:
+    def __init__(self):
+      self.attempts = 0
+    def __iter__(self):
+      return self
+    def __next__(self):
+      if errors:
+        self.attempts += 1
+        raise errors.pop(0)
+      return batch
+
+  data = Flaky2()
+  with pytest.raises(FileNotFoundError):
+    fit(step, state, data, num_steps=3, log_every=0)
+  assert data.attempts == 2                 # no retries burned after FNF
+
+
+def test_nonfinite_report_names_bad_leaves():
+  from easyparallellibrary_tpu.runtime.amp import nonfinite_report
+  tree = {"a": {"w": np.array([1.0, np.nan, np.inf]),
+                "b": np.ones(3)},
+          "n": np.array([1, 2], np.int32)}
+  report = nonfinite_report(tree)
+  assert report == {"a/w": 2}
+
+
+def test_lr_backoff_via_inject_hyperparams():
+  tx = optax.inject_hyperparams(optax.sgd)(learning_rate=0.5)
+  opt_state = tx.init({"w": jnp.ones((2,))})
+  new_state, applied = resilience.backoff_learning_rate(opt_state, 0.5)
+  assert applied
+  assert float(new_state.hyperparams["learning_rate"]) == 0.25
+
+  plain = optax.adam(1e-3).init({"w": jnp.ones((2,))})
+  same, applied = resilience.backoff_learning_rate(plain, 0.5)
+  assert not applied
+
+
+# ----------------------------------------------------------- watchdog --
+
+
+def test_watchdog_fires_and_disarms():
+  import time as _time
+  fired = []
+  dog = resilience.StepWatchdog(0.05, on_timeout=fired.append)
+  dog.arm(7)
+  _time.sleep(0.3)
+  assert fired == [7] and dog.timeouts_fired == 1
+  dog.arm(8)
+  dog.disarm()
+  _time.sleep(0.15)
+  assert fired == [7]                       # disarm cancelled it
+  dog.close()
+
+
+def test_fit_watchdog_logs_slow_step(tmp_path):
+  import logging
+  import time as _time
+  from easyparallellibrary_tpu.utils.logging import get_logger
+  cfg = epl.Config({"resilience": {"step_timeout_s": 0.1}})
+  state, shardings, step, batch = _setup(cfg)
+
+  class SlowOnce:
+    def __init__(self):
+      self.n = 0
+    def __iter__(self):
+      return self
+    def __next__(self):
+      self.n += 1
+      if self.n == 2:
+        _time.sleep(0.4)
+      return batch
+
+  records = []
+  handler = logging.Handler()
+  handler.emit = records.append
+  logger = get_logger()
+  logger.addHandler(handler)
+  try:
+    state, _ = fit(step, state, SlowOnce(), num_steps=3, log_every=0)
+  finally:
+    logger.removeHandler(handler)
+  assert int(state.step) == 3
+  assert any("watchdog" in r.getMessage() for r in records)
+
+
+# --------------------------------------------------------- preemption --
+
+
+def test_sigterm_handler_restored_after_step_exception():
+  state, shardings, step, batch = _setup()
+  mine = lambda *a: None
+  prev = signal.signal(signal.SIGTERM, mine)
+  try:
+    def boom(st, b, rng):
+      raise ValueError("step exploded")
+
+    with pytest.raises(ValueError):
+      fit(boom, state, [batch], num_steps=3, log_every=0,
+          checkpoint_dir="/tmp/does-not-matter-never-written")
+    # fit must have put OUR handler back despite the escaping exception.
+    assert signal.getsignal(signal.SIGTERM) is mine
+  finally:
+    signal.signal(signal.SIGTERM, prev)
+
+
+def test_keyboard_interrupt_saves_final_checkpoint(tmp_path):
+  state, shardings, step, batch = _setup()
+  ckpt = str(tmp_path / "ck")
+
+  class InterruptAt:
+    def __init__(self, n):
+      self.n, self.i = n, 0
+    def __iter__(self):
+      return self
+    def __next__(self):
+      self.i += 1
+      if self.i > self.n:
+        raise KeyboardInterrupt
+      return batch
+
+  with pytest.raises(KeyboardInterrupt):
+    fit(step, state, InterruptAt(3), num_steps=10, checkpoint_dir=ckpt,
+        log_every=0, shardings=shardings)
+  assert saver.latest_step(ckpt) == 3
+
+
+@pytest.mark.quick
+def test_preemption_roundtrip_bit_exact(tmp_path):
+  """SIGTERM mid-fit → checkpoint → resume: final params AND opt_state
+  are bit-identical to the uninterrupted run."""
+  batches = [_batch(s) for s in range(6)]
+
+  def snap(st):
+    return jax.tree_util.tree_map(
+        np.asarray, jax.device_get(
+            {"params": nn.unbox(st.params), "opt": st.opt_state}))
+
+  state, shardings, step, _ = _setup()
+  state, _ = fit(step, state, batches, num_steps=6, log_every=0,
+                 shardings=shardings)
+  uninterrupted = snap(state)
+
+  class PreemptingStream:
+    """Yields the deterministic batch sequence; delivers a real SIGTERM
+    while fetching the batch for step 3 (first pass only)."""
+    def __init__(self):
+      self.calls = []
+    def __call__(self, start_step=0):
+      self.calls.append(start_step)
+      def gen():
+        for i, b in enumerate(batches[start_step:]):
+          if start_step == 0 and i == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+          yield b
+      return gen()
+
+  ckpt = str(tmp_path / "ck")
+  stream = PreemptingStream()
+  state2, shardings2, step2, _ = _setup()
+  with pytest.raises(SystemExit):
+    fit(step2, state2, stream, num_steps=6, checkpoint_dir=ckpt,
+        log_every=0, shardings=shardings2)
+  saved = saver.latest_step(ckpt)
+  assert saved is not None and 3 <= saved <= 5
+
+  state3, shardings3, step3, _ = _setup()
+  state3, _ = fit(step3, state3, stream, num_steps=6, checkpoint_dir=ckpt,
+                  log_every=0, shardings=shardings3)
+  assert int(state3.step) == 6
+  assert stream.calls[-1] == saved          # input stream resumed in place
+  resumed = snap(state3)
+  jax.tree_util.tree_map(np.testing.assert_array_equal,
+                         uninterrupted, resumed)
