@@ -15,7 +15,12 @@ bench:
 chaos:
 	python -m pytest tests/test_resilience.py -q
 
+# Continuous batching vs static-batch generate() under Poisson arrivals
+# (benchmarks/decode_throughput.py -> BENCH_EVIDENCE.json; docs/serving.md).
+serve-bench:
+	python benchmarks/decode_throughput.py
+
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test bench chaos clean
+.PHONY: all build test bench chaos serve-bench clean

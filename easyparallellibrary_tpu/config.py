@@ -348,6 +348,36 @@ class ResilienceConfig(_Category):
   }
 
 
+class ServingConfig(_Category):
+  """Continuous-batching inference engine (serving/, docs/serving.md).
+  New vs the reference, which is training-only (SURVEY §1)."""
+  _name = "serving"
+  _fields = {
+      # Request slots in the preallocated KV cache = max concurrently
+      # resident requests.  Cache bytes scale linearly
+      # (serving.kv_cache.cache_bytes).
+      "num_slots": 8,
+      # Token width of the fused step: prefill streams through the
+      # engine this many prompt tokens per iteration (Sarathi-style
+      # chunked prefill); decode slots use 1 of the positions.  Larger =
+      # fewer prefill iterations but more compute per step.
+      "prefill_chunk": 16,
+      # Per-iteration cap on scheduled prompt tokens across all slots
+      # (admission control: decode latency vs prefill throughput).
+      # 0 = uncapped.  Must be 0 or >= prefill_chunk.
+      "prefill_token_budget": 0,
+      # Cap on concurrently active requests (0 = num_slots).
+      "max_batch": 0,
+      # Default stop-token id for requests that don't set one (-1 = no
+      # stop token; requests run to max_new_tokens).
+      "stop_token": -1,
+      # Donate the cache + cursor buffers to the jitted step (in-place
+      # update; steady-state device allocation = one cache).  Turn off
+      # only for debugging (keeps every step's input cache alive).
+      "donate_cache": True,
+  }
+
+
 class Config:
   """Root configuration (reference: epl/config.py:181).
 
@@ -362,6 +392,7 @@ class Config:
       AutoParallelConfig, IOConfig, CommunicationConfig, PipelineConfig,
       GradientCheckpointConfig, ZeroConfig, OffloadConfig, AMPConfig,
       ClusterConfig, OptimizerConfig, SequenceConfig, ResilienceConfig,
+      ServingConfig,
   )
 
   def __init__(self, param_dict: Dict[str, Any] | None = None):
@@ -468,6 +499,27 @@ class Config:
     if not 0 < self.resilience.rollback_lr_backoff <= 1:
       raise ValueError("resilience.rollback_lr_backoff must be in (0, 1]; "
                        f"got {self.resilience.rollback_lr_backoff}")
+    if self.serving.num_slots < 1:
+      raise ValueError(f"serving.num_slots must be >= 1; "
+                       f"got {self.serving.num_slots}")
+    if self.serving.prefill_chunk < 1:
+      raise ValueError(f"serving.prefill_chunk must be >= 1; "
+                       f"got {self.serving.prefill_chunk}")
+    if self.serving.prefill_token_budget < 0:
+      raise ValueError(f"serving.prefill_token_budget must be >= 0; "
+                       f"got {self.serving.prefill_token_budget}")
+    if 0 < self.serving.prefill_token_budget < self.serving.prefill_chunk:
+      raise ValueError(
+          "serving.prefill_token_budget must be 0 (uncapped) or >= "
+          f"serving.prefill_chunk ({self.serving.prefill_chunk}); a "
+          "smaller budget could never afford any request's first chunk; "
+          f"got {self.serving.prefill_token_budget}")
+    if self.serving.max_batch < 0:
+      raise ValueError(f"serving.max_batch must be >= 0; "
+                       f"got {self.serving.max_batch}")
+    if self.serving.stop_token < -1:
+      raise ValueError(f"serving.stop_token must be >= -1; "
+                       f"got {self.serving.stop_token}")
 
   def to_dict(self) -> Dict[str, Dict[str, Any]]:
     return {c._name: getattr(self, c._name).to_dict()

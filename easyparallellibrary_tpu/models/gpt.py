@@ -102,6 +102,63 @@ def _act_spec(cfg: GPTConfig, ndim: int = 3) -> P:
 from easyparallellibrary_tpu.utils.sharding import constrain as _constrain  # noqa: E402
 
 
+def slot_cache_attend(q, k, v, cached_k, cached_v, cursors, dtype):
+  """Slot-indexed KV-cache attention — the shared core of the legacy
+  single-request decode step and the serving engine's fused
+  prefill+decode step (serving/engine.py).
+
+  ``q``/``k``/``v`` are ``[B, C, H, hd]`` projections of this step's C
+  new tokens per slot (C == 1 for pure decode), ``cached_k``/``cached_v``
+  are ``[B, Lc, H, hd]`` per-slot caches, and ``cursors`` is an int32
+  ``[B]`` vector of write offsets — how many tokens each slot already
+  holds.  Token ``i`` of slot ``b`` lands at cache position
+  ``cursors[b] + i`` and attends causally over positions
+  ``<= cursors[b] + i``, so a chunk replays exactly the dense causal
+  prefill for its token range.  ``Lc`` must be at least
+  ``max(cursors) + C`` (the serving cache is over-allocated by one chunk,
+  kv_cache.cache_length) so the write never clamps.
+
+  Slots whose chunk is only partially valid write garbage K/V beyond
+  their valid tokens; that region sits at positions ``> cursors[b] + i``
+  for every valid query ``i``, is masked here, and is overwritten before
+  the cursor ever reaches it (the next chunk's write window covers it).
+  Stale K/V from a previous slot occupant is masked the same way — a
+  reused slot only ever attends to positions its own tokens have
+  written.
+
+  Returns ``(out [B, C, H, hd], new_cached_k, new_cached_v)``.
+  """
+  B, C, H, hd = q.shape
+  Lc = cached_k.shape[1]
+  scale = 1.0 / jnp.sqrt(hd).astype(dtype)
+
+  def write(cache, new):
+    return jax.vmap(
+        lambda row, chunk, cur: jax.lax.dynamic_update_slice(
+            row, chunk, (cur, 0, 0)))(cache, new.astype(cache.dtype),
+                                      cursors)
+
+  cached_k = write(cached_k, k)
+  cached_v = write(cached_v, v)
+  logits = jnp.einsum("bqhd,bkhd->bhqk", q, cached_k) * scale
+  # Key position j is visible to query i (absolute position cursor+i)
+  # iff j <= cursor + i: the query's own causal prefix, nothing newer,
+  # nothing stale.
+  pos = cursors[:, None, None, None] + jnp.arange(C)[None, None, :, None]
+  valid = jnp.arange(Lc)[None, None, None, :] <= pos
+  logits = jnp.where(valid, logits, jnp.asarray(-1e9, logits.dtype))
+  probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+  out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dtype), cached_v)
+  return out, cached_k, cached_v
+
+
+def _missing_slot_cache():
+  raise ValueError(
+      "slot-mode decode (slot_cursors=...) needs an externally allocated "
+      "slot KV cache passed in the 'cache' collection; build one with "
+      "serving.kv_cache.allocate_kv_cache(cfg, num_slots, chunk)")
+
+
 def _dense_causal_attention(q, k, v, dtype):
   """Reference XLA attention: bf16 matmuls, fp32 softmax, causal mask.
   Shared by the training path and the KV-cache prefill so the two can
@@ -121,7 +178,7 @@ class CausalSelfAttention(nn.Module):
   decode: bool = False
 
   @nn.compact
-  def __call__(self, x):
+  def __call__(self, x, slot_cursors=None):
     cfg = self.cfg
     B, S, D = x.shape
     H = cfg.num_heads
@@ -139,7 +196,7 @@ class CausalSelfAttention(nn.Module):
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
     if self.decode:
-      out = self._decode_attend(q, k, v)
+      out = self._decode_attend(q, k, v, slot_cursors)
     elif cfg.attn_impl == "ring":
       from easyparallellibrary_tpu.sequence.ring_attention import (
           ring_attention)
@@ -165,17 +222,36 @@ class CausalSelfAttention(nn.Module):
                 param_dtype=cfg.param_dtype, name="proj")(out)
     return _constrain(out, _act_spec(cfg))
 
-  def _decode_attend(self, q, k, v):
+  def _decode_attend(self, q, k, v, slot_cursors=None):
     """KV-cached attention (VERDICT round-1 item 10).
 
-    Prefill (S > 1): normal causal attention; the prompt's K/V land in
-    the cache.  Step (S == 1): append this token's K/V at the cache
-    cursor and attend over the valid prefix — O(1) forwards per token
-    instead of the full-forward-per-token fallback.
+    Two cache layouts share :func:`slot_cache_attend` as their math:
+
+    * Legacy (``slot_cursors=None``) — one whole request per call, cache
+      ``[B, max_seq_len, H, hd]`` with one scalar cursor for the whole
+      batch.  Prefill (S > 1): normal causal attention; the prompt's K/V
+      land in the cache.  Step (S == 1): append this token's K/V at the
+      cursor and attend over the valid prefix — O(1) forwards per token
+      instead of the full-forward-per-token fallback.
+    * Slot mode (``slot_cursors`` = int32 ``[B]`` vector) — the serving
+      engine's layout: B is a SLOT index (requests at different decode
+      depths coexist in one batch), the cache is slot-indexed and
+      preallocated externally (serving/kv_cache.py; this module never
+      allocates it), and every call is one fused chunk step — prefill
+      chunks and single decode tokens distinguished purely by how many
+      of the C token positions each slot's cursor math treats as live.
     """
     cfg = self.cfg
     B, S, H, hd = q.shape
     L = cfg.max_seq_len
+
+    if slot_cursors is not None:
+      ck = self.variable("cache", "cached_key", _missing_slot_cache)
+      cv = self.variable("cache", "cached_value", _missing_slot_cache)
+      out, ck.value, cv.value = slot_cache_attend(
+          q, k, v, ck.value, cv.value, slot_cursors, cfg.dtype)
+      return out
+
     ck = self.variable("cache", "cached_key",
                        lambda: jnp.zeros((B, L, H, hd), cfg.dtype))
     cv = self.variable("cache", "cached_value",
@@ -191,18 +267,12 @@ class CausalSelfAttention(nn.Module):
       ci.value = jnp.int32(S)
       return _dense_causal_attention(q, k, v, cfg.dtype)
 
-    scale = 1.0 / jnp.sqrt(hd).astype(cfg.dtype)
-    idx = ci.value
-    ck.value = jax.lax.dynamic_update_slice(
-        ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-    cv.value = jax.lax.dynamic_update_slice(
-        cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
-    ci.value = idx + 1
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) * scale  # k over L
-    valid = (jnp.arange(L) <= idx)[None, None, None, :]
-    logits = jnp.where(valid, logits, jnp.asarray(-1e9, logits.dtype))
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), cv.value)
+    # One-token step == slot attention with a batch-uniform cursor.
+    cursors = jnp.broadcast_to(ci.value, (B,))
+    out, ck.value, cv.value = slot_cache_attend(
+        q, k, v, ck.value, cv.value, cursors, cfg.dtype)
+    ci.value = ci.value + 1
+    return out
 
 
 class MLP(nn.Module):
@@ -228,14 +298,14 @@ class Block(nn.Module):
   decode: bool = False
 
   @nn.compact
-  def __call__(self, x):
+  def __call__(self, x, slot_cursors=None):
     cfg = self.cfg
     drop = nn.Dropout(rate=cfg.dropout_rate,
                       deterministic=self.deterministic
                       or cfg.dropout_rate == 0.0)
     y = LayerNorm(dtype=cfg.dtype, name="ln1")(x)
     x = x + drop(CausalSelfAttention(cfg, decode=self.decode,
-                                     name="attn")(y))
+                                     name="attn")(y, slot_cursors))
     y = LayerNorm(dtype=cfg.dtype, name="ln2")(x)
     if self.use_moe:
       from easyparallellibrary_tpu.models.moe import MoEMLP
@@ -384,18 +454,31 @@ class GPT(nn.Module):
 
   @nn.compact
   def __call__(self, ids, deterministic: bool = True,
-               decode: bool = False, return_hidden: bool = False):
+               decode: bool = False, return_hidden: bool = False,
+               slot_cursors=None):
     from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
     cfg = resolve_model_dtypes(self.cfg)
     B, S = ids.shape
     if decode and cfg.pipeline_stages > 1:
       raise ValueError("KV-cache decode is single-program; run generation "
                        "on a non-pipelined config (pipeline_stages=1)")
+    if slot_cursors is not None and not decode:
+      raise ValueError("slot_cursors is a decode-mode argument "
+                       "(serving engine); pass decode=True")
     tok = _tied_embedding(cfg, name="wte")
     pos_init = nn.initializers.normal(stddev=0.02)
     pos = self.param("wpe", nn.with_partitioning(pos_init, (None, None)), (cfg.max_seq_len, cfg.d_model),
                      cfg.param_dtype)
-    if decode:
+    if slot_cursors is not None:
+      # Slot mode (serving): absolute positions come straight from the
+      # per-slot cursor vector — no pos_index variable; the engine owns
+      # cursor advancement.  Past-capacity positions of garbage token
+      # slots clip into range (their outputs are never consumed).
+      pos_ids = jnp.clip(slot_cursors[:, None] + jnp.arange(S)[None],
+                         0, cfg.max_seq_len - 1)
+      pos_slice = jnp.take(jnp.asarray(pos), pos_ids, axis=0)  # [B, S, D]
+      x = tok(ids).astype(cfg.dtype) + pos_slice.astype(cfg.dtype)
+    elif decode:
       # Absolute positions while stepping: the cursor mirrors the
       # attention caches' index (prefill pins it to S).
       pi = self.variable("cache", "pos_index",
@@ -408,9 +491,10 @@ class GPT(nn.Module):
         pi.value = pi.value + 1
       pos_slice = jax.lax.dynamic_slice(
           jnp.asarray(pos), (offset, 0), (S, cfg.d_model))
+      x = tok(ids).astype(cfg.dtype) + pos_slice[None].astype(cfg.dtype)
     else:
       pos_slice = jnp.asarray(pos)[:S]
-    x = tok(ids).astype(cfg.dtype) + pos_slice[None].astype(cfg.dtype)
+      x = tok(ids).astype(cfg.dtype) + pos_slice[None].astype(cfg.dtype)
     x = _constrain(x, _act_spec(cfg))
 
     if cfg.pipeline_stages > 1:
@@ -459,7 +543,7 @@ class GPT(nn.Module):
         use_moe = cfg.num_experts > 0 and \
           (i % cfg.moe_every == cfg.moe_every - 1)
         x = block_cls(cfg, use_moe=use_moe, deterministic=deterministic,
-                      decode=decode, name=f"block_{i}")(x)
+                      decode=decode, name=f"block_{i}")(x, slot_cursors)
 
     x = LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
     if return_hidden:
@@ -1035,6 +1119,10 @@ def auto_parallel_gpt(cfg: GPTConfig, config=None) -> GPT:
 # is identical for every trace/step, so repeating it per trace is noise.
 _SMAP_ADVICE_LOGGED = [False]
 
+# Same once-gating for generate()'s pipeline fallback: the reason is
+# identical for every call, and generation loops call generate() often.
+_PP_GENERATE_FALLBACK_LOGGED = [False]
+
 
 def _smap_preconditions_ok(cfg: GPTConfig, conf, sched) -> bool:
   """True iff ``pipeline.engine='smap'`` would accept this exact config —
@@ -1213,6 +1301,24 @@ def generate(model: GPT, params, prompt_ids, max_new_tokens: int,
 
   if max_new_tokens <= 0:
     return ids
+
+  if use_cache and model.cfg.pipeline_stages > 1 and \
+      not _PP_GENERATE_FALLBACK_LOGGED[0]:
+    # The silent O(S)-per-token cliff, surfaced (once per process — same
+    # latch pattern as the smap advisory): KV-cache decode is a single
+    # program (GPT.__call__ rejects decode=True under pipelining), so a
+    # pipelined config re-runs the FULL forward for every generated
+    # token.
+    _PP_GENERATE_FALLBACK_LOGGED[0] = True
+    from easyparallellibrary_tpu.utils.logging import get_logger
+    get_logger().warning(
+        "generate(use_cache=True) on a pipelined config "
+        "(pipeline_stages=%d) falls back to full-forward-per-token: "
+        "KV-cache decode is single-program and cannot span pipeline "
+        "stages.  Restore the checkpoint into a pipeline_stages=1 config "
+        "(runtime.saver.restore_params) for O(1)-per-token decoding or "
+        "the serving engine (docs/serving.md).  (Logged once per "
+        "process.)", model.cfg.pipeline_stages)
 
   if use_cache and model.cfg.pipeline_stages <= 1:
     # Prefill: one full forward over the prompt populates the caches.

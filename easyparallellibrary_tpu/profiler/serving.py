@@ -1,0 +1,143 @@
+"""Serving-side metrics: tokens/s, TTFT, inter-token latency, occupancy.
+
+The training profilers in this package score steps (flops.py) and bytes
+(memory.py); serving is scored by what a CLIENT observes, so the
+counters here are request-lifecycle timestamps aggregated into the
+standard serving quartet:
+
+* **tokens/s** — aggregate generated-token throughput over the engine's
+  busy wall-clock (the number continuous batching exists to raise);
+* **TTFT** — time-to-first-token per request (admission latency +
+  prefill), p50/p99;
+* **ITL** — mean inter-token latency per request after the first token
+  (the decode cadence a streaming client feels), p50/p99 across
+  requests;
+* **slot occupancy** — mean fraction of KV-cache slots doing work per
+  step (how full the continuous batch actually runs; low occupancy with
+  a deep queue means admission is the bottleneck).
+
+The engine feeds these via the ``note_*`` hooks; ``summary()`` rolls
+them up for logs / ``MetricsWriter`` / BENCH_EVIDENCE records.  Host
+wall-clock only — nothing here touches the device or forces a sync
+beyond the engine's own per-step token fetch.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+
+def percentile(values: List[float], q: float) -> float:
+  """Nearest-rank percentile (q in [0, 100]); 0.0 on empty input.  Kept
+  dependency-free and deterministic — benchmark records must not drift
+  with numpy interpolation-mode defaults."""
+  if not values:
+    return 0.0
+  xs = sorted(values)
+  rank = max(0, min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1)))))
+  return float(xs[rank])
+
+
+class _RequestTrace:
+  __slots__ = ("submitted_at", "admitted_at", "first_token_at",
+               "finished_at", "new_tokens")
+
+  def __init__(self, now: float):
+    self.submitted_at = now
+    self.admitted_at: Optional[float] = None
+    self.first_token_at: Optional[float] = None
+    self.finished_at: Optional[float] = None
+    self.new_tokens = 0
+
+
+class ServingStats:
+  """Request-lifecycle and per-step counters for the serving engine.
+
+  ``clock`` is injectable for deterministic tests.  All ``note_*`` hooks
+  are cheap (dict insert / float math) and safe to call from the
+  engine's host loop.
+  """
+
+  def __init__(self, clock=time.monotonic):
+    self._clock = clock
+    self.reset()
+
+  def reset(self):
+    """Zero every counter and trace — call after an engine warmup so the
+    compile step never pollutes throughput/latency rollups."""
+    self._req: Dict[Any, _RequestTrace] = {}
+    self.steps = 0
+    self.busy_time_s = 0.0
+    self.prefill_tokens = 0
+    self.decode_tokens = 0
+    self.finished_requests = 0
+    self.generated_tokens = 0
+    self._occupancy_sum = 0.0
+
+  # ------------------------------------------------------------ lifecycle
+
+  def note_submitted(self, uid: Any):
+    self._req[uid] = _RequestTrace(self._clock())
+
+  def note_admitted(self, uid: Any):
+    tr = self._req.setdefault(uid, _RequestTrace(self._clock()))
+    tr.admitted_at = self._clock()
+
+  def note_first_token(self, uid: Any):
+    tr = self._req.setdefault(uid, _RequestTrace(self._clock()))
+    tr.first_token_at = self._clock()
+
+  def note_finished(self, uid: Any, new_tokens: int):
+    tr = self._req.setdefault(uid, _RequestTrace(self._clock()))
+    tr.finished_at = self._clock()
+    tr.new_tokens = int(new_tokens)
+    self.finished_requests += 1
+    self.generated_tokens += int(new_tokens)
+
+  # ----------------------------------------------------------------- step
+
+  def note_step(self, active_slots: int, num_slots: int,
+                prefill_tokens: int, decode_tokens: int,
+                step_time_s: float):
+    self.steps += 1
+    self.busy_time_s += step_time_s
+    self.prefill_tokens += prefill_tokens
+    self.decode_tokens += decode_tokens
+    self._occupancy_sum += active_slots / max(num_slots, 1)
+
+  # -------------------------------------------------------------- rollup
+
+  def _ttfts(self) -> List[float]:
+    return [tr.first_token_at - tr.submitted_at
+            for tr in self._req.values()
+            if tr.first_token_at is not None]
+
+  def _itls(self) -> List[float]:
+    """Per-request mean inter-token latency (requests with >= 2 new
+    tokens; a single-token request has no inter-token gap)."""
+    out = []
+    for tr in self._req.values():
+      if (tr.finished_at is not None and tr.first_token_at is not None
+          and tr.new_tokens >= 2):
+        out.append((tr.finished_at - tr.first_token_at)
+                   / (tr.new_tokens - 1))
+    return out
+
+  def summary(self) -> Dict[str, float]:
+    ttfts, itls = self._ttfts(), self._itls()
+    busy = max(self.busy_time_s, 1e-9)
+    return {
+        "steps": float(self.steps),
+        "finished_requests": float(self.finished_requests),
+        "generated_tokens": float(self.generated_tokens),
+        "tokens_per_s": self.generated_tokens / busy,
+        "prefill_tokens_per_s": self.prefill_tokens / busy,
+        "ttft_p50_s": percentile(ttfts, 50),
+        "ttft_p99_s": percentile(ttfts, 99),
+        "itl_mean_s": (sum(itls) / len(itls)) if itls else 0.0,
+        "itl_p50_s": percentile(itls, 50),
+        "itl_p99_s": percentile(itls, 99),
+        "slot_occupancy_mean": (self._occupancy_sum / self.steps
+                                if self.steps else 0.0),
+    }

@@ -446,6 +446,36 @@ def _slice_to_shape(value: np.ndarray, shape: Tuple[int, ...],
   return value[slices]
 
 
+def _walk_valid_checkpoints(directory: str):
+  """Yield checksum-VALID checkpoint candidates under `directory`,
+  newest first — THE fallback-chain protocol, shared by
+  :func:`restore_checkpoint`, :func:`restore_params` and
+  :func:`latest_step` so its semantics (warn, quarantine, fall back)
+  cannot drift between them.  Raises FileNotFoundError when no
+  candidate exists at all; raises :class:`NoValidCheckpointError` after
+  the final yield if the consumer exhausts the chain (candidates
+  existed but every one failed validation)."""
+  candidates = _candidate_dirs(directory)
+  if not candidates:
+    raise FileNotFoundError(
+        f"no checkpoint found under {directory!r} (no index.json and no "
+        f"step_N subdirectories)")
+  log = get_logger()
+  for path in candidates:
+    ok, reason = verify_checkpoint(path)
+    if ok:
+      yield path
+      continue
+    log.warning("checkpoint %s failed validation (%s); falling back to "
+                "the previous checkpoint", path, reason)
+    if path != directory:
+      _quarantine(path)
+  raise NoValidCheckpointError(
+      f"no VALID checkpoint under {directory!r}: all {len(candidates)} "
+      f"candidate(s) failed validation (corrupt ones quarantined as "
+      f"*{CORRUPT_SUFFIX})")
+
+
 def restore_checkpoint(directory: str,
                        target=None,
                        shardings=None,
@@ -472,25 +502,9 @@ def restore_checkpoint(directory: str,
   Returns ``(tree, step)`` with `step` taken from the checkpoint
   actually restored (callers must not assume it is the newest on disk).
   """
-  candidates = _candidate_dirs(directory)
-  if not candidates:
-    raise FileNotFoundError(
-        f"no checkpoint found under {directory!r} (no index.json and no "
-        f"step_N subdirectories)")
-  log = get_logger()
-  for path in candidates:
-    ok, reason = verify_checkpoint(path)
-    if ok:
-      return _restore_from(path, target, shardings, assign_map,
-                           slice_offsets)
-    log.warning("checkpoint %s failed validation (%s); falling back to "
-                "the previous checkpoint", path, reason)
-    if path != directory:
-      _quarantine(path)
-  raise NoValidCheckpointError(
-      f"no VALID checkpoint under {directory!r}: all {len(candidates)} "
-      f"candidate(s) failed validation (corrupt ones quarantined as "
-      f"*{CORRUPT_SUFFIX})")
+  for path in _walk_valid_checkpoints(directory):
+    return _restore_from(path, target, shardings, assign_map,
+                         slice_offsets)
 
 
 def _restore_from(directory: str,
@@ -498,8 +512,12 @@ def _restore_from(directory: str,
                   shardings=None,
                   assign_map: Optional[Dict[str, str]] = None,
                   slice_offsets: Optional[Dict[str, Tuple[int, ...]]]
-                  = None):
-  """Restore one already-validated checkpoint directory."""
+                  = None,
+                  leaf_filter=None):
+  """Restore one already-validated checkpoint directory.  ``leaf_filter``
+  (no-target mode only) restricts which leaves load — shards holding
+  only filtered-out leaves are never opened (restore_params' reason not
+  to touch optimizer state)."""
   from easyparallellibrary_tpu.utils.retry import retry_call
   with open(os.path.join(directory, INDEX_FILE)) as f:
     index = json.load(f)
@@ -520,7 +538,8 @@ def _restore_from(directory: str,
     return cache[shard][ckpt_path]
 
   if target is None:
-    out = {p: load_leaf(p) for p in index["leaves"]}
+    out = {p: load_leaf(p) for p in index["leaves"]
+           if leaf_filter is None or leaf_filter(p)}
     return out, index.get("step")
 
   flat_boxed, _ = jax.tree_util.tree_flatten_with_path(
@@ -553,27 +572,69 @@ def _restore_from(directory: str,
   return restored, index.get("step")
 
 
+def restore_params(directory: str,
+                   target=None,
+                   shardings=None,
+                   assign_map: Optional[Dict[str, str]] = None):
+  """Params-only restore for serving (docs/serving.md).
+
+  Walks the same checksum-validated newest-first fallback chain as
+  :func:`restore_checkpoint` — corrupt candidates are quarantined and
+  skipped — but loads ONLY the model parameters: optimizer moments,
+  step counters and sentinel state are never read off disk, so serving a
+  checkpoint does not construct (or pay host memory for) a TrainState.
+
+  Works on both checkpoint flavors: a full TrainState checkpoint (leaves
+  under ``params/`` — the training loop's layout) and a bare params-tree
+  checkpoint; the ``params/`` prefix is detected from the index and
+  applied automatically.  ``target`` should be a params pytree (e.g.
+  ``model.init(...)["params"]`` or an ``eval_shape`` of it);
+  ``shardings`` a matching pytree of NamedShardings to place onto the
+  serving mesh.  Explicit ``assign_map`` patterns win over the automatic
+  prefix and must map to full checkpoint names.  Without ``target``,
+  returns the raw ``{path: array}`` dict of just the params leaves
+  (prefix stripped).
+
+  Returns ``(params, step)``.
+  """
+  prefix = "params/"
+  for path in _walk_valid_checkpoints(directory):
+    with open(os.path.join(path, INDEX_FILE)) as f:
+      leaves = json.load(f).get("leaves", {})
+    prefixed = any(p.startswith(prefix) for p in leaves)
+    if target is None:
+      keep = (lambda p: p.startswith(prefix)) if prefixed else None
+      tree, step = _restore_from(path, leaf_filter=keep)
+      if prefixed:
+        tree = {p[len(prefix):]: v for p, v in tree.items()}
+      return tree, step
+    amap = dict(assign_map) if assign_map else {}
+    if prefixed:
+      # Applied last (first match wins): explicit entries already name
+      # full checkpoint paths.
+      amap.setdefault("^", prefix)
+    return _restore_from(path, target, shardings, amap)
+
+
 def latest_step(directory: str) -> Optional[int]:
   """Step of the newest VALID checkpoint under `directory` (a checkpoint
   root or a single checkpoint dir), or None.
 
-  Validation matches :func:`restore_checkpoint` — index parses and every
-  shard's size/sha256 checks out — so the step returned here is one the
-  restore will actually succeed on.  Corrupt/unparsable candidates are
-  logged, quarantined, and skipped instead of crashing the resume path.
+  Validation matches :func:`restore_checkpoint` — the same fallback
+  chain (:func:`_walk_valid_checkpoints`) — so the step returned here is
+  one the restore will actually succeed on.  Corrupt/unparsable
+  candidates are logged, quarantined, and skipped instead of crashing
+  the resume path.
   """
-  log = get_logger()
-  for path in _candidate_dirs(directory):
-    ok, reason = verify_checkpoint(path)
-    if ok:
+  try:
+    for path in _walk_valid_checkpoints(directory):
       try:
         with open(os.path.join(path, INDEX_FILE)) as f:
           return json.load(f).get("step")
       except (OSError, ValueError):  # pragma: no cover - raced deletion
         continue
-    log.warning("skipping invalid checkpoint %s (%s)", path, reason)
-    if path != directory:
-      _quarantine(path)
+  except (FileNotFoundError, NoValidCheckpointError):
+    return None
   return None
 
 

@@ -1,0 +1,26 @@
+"""Serving subsystem — continuous-batching inference over the TP mesh.
+
+The fourth runtime mode (train / eval / generate / serve): a slot-based
+preallocated KV cache (:mod:`kv_cache`), a host-side FCFS scheduler with
+chunked-prefill admission (:mod:`scheduler`), and a single-jitted-step
+engine that fuses prefill and decode so requests join and leave the
+batch every iteration (:mod:`engine`).  See docs/serving.md.
+"""
+
+from easyparallellibrary_tpu.serving.engine import (
+    ContinuousBatchingEngine, sample_token_slots,
+)
+from easyparallellibrary_tpu.serving.kv_cache import (
+    SlotAllocator, allocate_kv_cache, cache_bytes, cache_length,
+    kv_cache_shardings,
+)
+from easyparallellibrary_tpu.serving.scheduler import (
+    FCFSScheduler, FinishedRequest, Request, StepPlan,
+)
+
+__all__ = [
+    "ContinuousBatchingEngine", "sample_token_slots",
+    "SlotAllocator", "allocate_kv_cache", "cache_bytes", "cache_length",
+    "kv_cache_shardings",
+    "FCFSScheduler", "FinishedRequest", "Request", "StepPlan",
+]

@@ -1,0 +1,249 @@
+"""Continuous-batching inference engine over the TP mesh.
+
+The fourth runtime mode (train / eval / generate / **serve**): ONE
+jitted step — compiled once, shapes never change — fuses
+
+  * prefill of newly admitted requests (their next prompt chunk), and
+  * one-token decode of every other active slot
+
+into a single ``[num_slots, chunk]`` model call against the slot KV
+cache (kv_cache.py), per-slot cursors selecting each slot's absolute
+positions and causal window (models/gpt.py ``slot_cache_attend``).
+Requests therefore join and leave the batch every iteration with zero
+recompilation — iteration-level batching as in Orca (OSDI'22) — and the
+cache + cursor buffers are donated, so the engine's steady-state device
+allocation is exactly one cache.
+
+Division of labor: :class:`FCFSScheduler` (scheduler.py) owns all
+host-side variability (admission, budgets, retirement, RNG streams);
+this module owns the device program and its placement.  Sampling runs
+per-slot inside the step (:func:`sample_token_slots` — the traced-
+parameter twin of ``sample_logits``) with per-request keys folded by
+token index, so a request's sample stream is independent of which slot
+or iteration serves it.
+
+Exactness contract: greedy engine output is bit-identical (token ids)
+to ``generate(use_cache=True)`` per request — the legacy path stays the
+oracle (tests/test_serving.py), including requests admitted mid-flight
+and slots reused after retirement.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.serving import kv_cache as kv_lib
+from easyparallellibrary_tpu.serving.scheduler import (
+    FCFSScheduler, FinishedRequest, Request)
+from easyparallellibrary_tpu.utils.logging import get_logger
+
+
+def sample_token_slots(logits, keys, temperature, top_k, top_p):
+  """Per-slot sampling with TRACED parameters — the vectorized twin of
+  ``models.gpt.sample_logits`` (same filter semantics and order: top-k,
+  then top-p over the survivors; ``temperature<=0`` is greedy), for the
+  serving step where every slot carries its own sampling knobs and every
+  value must be an array (static per-request values would recompile the
+  fused step per parameter combination).
+
+  ``logits`` [N, V]; ``keys`` uint32 [N, 2] per-slot PRNG keys;
+  ``temperature``/``top_p`` f32 [N]; ``top_k`` int32 [N] (0 disables).
+  Returns int32 [N] token ids.
+  """
+  V = logits.shape[-1]
+  greedy = jnp.argmax(logits, axis=-1)
+  neg = jnp.asarray(-1e30, logits.dtype)
+  t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+  scaled = logits / t.astype(logits.dtype)
+  # top-k with a traced k: threshold at the k-th largest value (ties at
+  # the threshold survive, exactly like sample_logits' `logits < kth`).
+  sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+  kth = jnp.take_along_axis(
+      sorted_desc, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=-1)
+  k_off = (top_k[:, None] <= 0) | (top_k[:, None] >= V)
+  scaled = jnp.where((scaled >= kth) | k_off, scaled, neg)
+  # top-p over the survivors: keep entries whose PRECEDING mass is < p
+  # (the crossing token survives; the top token always survives).
+  sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+  probs = jax.nn.softmax(sorted_desc.astype(jnp.float32), axis=-1)
+  cum = jnp.cumsum(probs, axis=-1)
+  keep_sorted = (cum - probs) < top_p[:, None]
+  thresh = jnp.min(jnp.where(keep_sorted, sorted_desc,
+                             jnp.asarray(jnp.inf, scaled.dtype)),
+                   axis=-1, keepdims=True)
+  p_on = top_p[:, None] < 1.0
+  scaled = jnp.where(p_on & (scaled < thresh), neg, scaled)
+  sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+  return jnp.where(temperature <= 0, greedy, sampled).astype(jnp.int32)
+
+
+class ContinuousBatchingEngine:
+  """Slot-based continuous-batching decode engine for a (non-pipelined)
+  GPT.
+
+  ``params`` may be boxed (flax Partitioned) or plain; with ``mesh``
+  they should already live in their sharded layout (e.g. from
+  ``create_sharded_train_state`` or ``runtime.saver.restore_params``)
+  and the cache is allocated heads-over-TP on the same mesh.  All knobs
+  default from the active ``Config``'s ``serving.*`` group.
+
+  Typical drive::
+
+      eng = ContinuousBatchingEngine(model, params, mesh=mesh)
+      eng.submit(Request(uid="a", prompt=ids, max_new_tokens=32))
+      outputs = eng.run()          # {uid: prompt+generated np.int32}
+  """
+
+  def __init__(self, model, params, *, mesh=None,
+               num_slots: Optional[int] = None,
+               prefill_chunk: Optional[int] = None,
+               prefill_token_budget: Optional[int] = None,
+               max_batch: Optional[int] = None,
+               stop_token: Optional[int] = None,
+               donate_cache: Optional[bool] = None,
+               stats=None, metrics_writer=None,
+               config=None):
+    cfg = model.cfg
+    conf = (config if config is not None else Env.get().config).serving
+    if cfg.pipeline_stages > 1:
+      raise ValueError(
+          "the serving engine is single-program (pipeline_stages=1); "
+          "restore the checkpoint into a non-pipelined config "
+          "(runtime.saver.restore_params) — see docs/serving.md")
+    if cfg.num_experts > 0:
+      raise ValueError("serving MoE checkpoints is not supported yet "
+                       "(ROADMAP open item)")
+    self.model = model
+    self.params = params
+    self.mesh = mesh
+    self.num_slots = num_slots if num_slots is not None else conf.num_slots
+    self.chunk = (prefill_chunk if prefill_chunk is not None
+                  else conf.prefill_chunk)
+    if self.chunk > cfg.max_seq_len:
+      raise ValueError(f"prefill_chunk {self.chunk} exceeds max_seq_len "
+                       f"{cfg.max_seq_len}")
+    budget = (prefill_token_budget if prefill_token_budget is not None
+              else conf.prefill_token_budget)
+    if budget > 0 and budget < self.chunk:
+      raise ValueError(
+          f"prefill_token_budget {budget} below prefill_chunk "
+          f"{self.chunk}: no admission could ever afford its first chunk")
+    self.scheduler = FCFSScheduler(
+        num_slots=self.num_slots, prefill_chunk=self.chunk,
+        max_seq_len=cfg.max_seq_len, prefill_token_budget=budget,
+        max_batch=max_batch if max_batch is not None else conf.max_batch,
+        stop_token=stop_token if stop_token is not None
+        else conf.stop_token)
+    self.stats = stats
+    self.metrics_writer = metrics_writer
+    if stats is not None:
+      self.scheduler.on_admit = stats.note_admitted
+      self.scheduler.on_first_token = stats.note_first_token
+      self.scheduler.on_finish = lambda fin: stats.note_finished(
+          fin.uid, fin.new_tokens)
+    self._kv, self._cursors = kv_lib.allocate_kv_cache(
+        cfg, self.num_slots, self.chunk, mesh)
+    self._steps = 0
+    donate = conf.donate_cache if donate_cache is None else donate_cache
+    self._step_fn = self._build_step(donate)
+    get_logger().info(
+        "serving engine: %d slots x chunk %d (cache %.1f MB, %s), "
+        "prefill budget %s, max batch %d", self.num_slots, self.chunk,
+        kv_lib.cache_bytes(cfg, self.num_slots, self.chunk) / 1e6,
+        "mesh-sharded" if mesh is not None else "single-program",
+        budget or "uncapped", self.scheduler.max_batch)
+
+  # ----------------------------------------------------------- device step
+
+  def _build_step(self, donate: bool):
+    model = self.model
+    C = self.chunk
+
+    def step(params, kv, cursors, tokens, num_valid, reset, keys,
+             tok_index, temperature, top_k, top_p):
+      cursors = jnp.where(reset, 0, cursors)
+      logits, mut = model.apply(
+          {"params": params, "cache": kv}, tokens, decode=True,
+          slot_cursors=cursors, mutable=["cache"])
+      # Each slot's next-token logits sit at its LAST live chunk
+      # position; idle slots (num_valid=0) read position 0 — garbage the
+      # scheduler never consumes.
+      last = jnp.take_along_axis(
+          logits, jnp.clip(num_valid - 1, 0, C - 1)[:, None, None],
+          axis=1)[:, 0]
+      step_keys = jax.vmap(jax.random.fold_in)(keys, tok_index)
+      nxt = sample_token_slots(last.astype(jnp.float32), step_keys,
+                               temperature, top_k, top_p)
+      return nxt, mut["cache"], cursors + num_valid
+
+    jit_kwargs: Dict[str, Any] = {}
+    if donate:
+      jit_kwargs["donate_argnums"] = (1, 2)   # cache + cursors
+    if self.mesh is not None:
+      from easyparallellibrary_tpu.parallel.api import state_shardings
+      kv_sh, cur_sh = kv_lib.kv_cache_shardings(model.cfg, self.mesh)
+      param_sh = state_shardings(self.params, self.mesh)
+      rep = cur_sh
+      jit_kwargs["in_shardings"] = (
+          param_sh, kv_sh, cur_sh, rep, rep, rep, rep, rep, rep, rep, rep)
+      jit_kwargs["out_shardings"] = (rep, kv_sh, cur_sh)
+    return jax.jit(step, **jit_kwargs)
+
+  # ------------------------------------------------------------ host loop
+
+  def submit(self, request: Request):
+    if self.stats is not None:
+      self.stats.note_submitted(request.uid)
+    self.scheduler.submit(request)
+
+  @property
+  def has_work(self) -> bool:
+    return self.scheduler.has_work
+
+  def step(self) -> List[FinishedRequest]:
+    """One engine iteration: plan -> fused device step -> commit.
+    Returns the requests that retired this iteration (empty when idle)."""
+    plan = self.scheduler.plan_step()
+    if plan is None:
+      return []
+    t0 = time.monotonic()
+    nxt, self._kv, self._cursors = self._step_fn(
+        self.params, self._kv, self._cursors, plan.tokens,
+        plan.num_valid, plan.reset, plan.keys, plan.tok_index,
+        plan.temperature, plan.top_k, plan.top_p)
+    finished = self.scheduler.commit(np.asarray(nxt))
+    self._steps += 1
+    dt = time.monotonic() - t0
+    if self.stats is not None:
+      self.stats.note_step(
+          active_slots=plan.active_slots, num_slots=self.num_slots,
+          prefill_tokens=plan.prefill_tokens,
+          decode_tokens=plan.decode_tokens, step_time_s=dt)
+    if self.metrics_writer is not None:
+      self.metrics_writer.write(self._steps, {
+          "active_slots": plan.active_slots,
+          "slot_occupancy": plan.active_slots / self.num_slots,
+          "prefill_tokens": plan.prefill_tokens,
+          "decode_tokens": plan.decode_tokens,
+          "step_time_s": dt,
+      })
+    return finished
+
+  def run(self, max_steps: Optional[int] = None
+          ) -> Dict[Any, np.ndarray]:
+    """Drive until the queue drains (or ``max_steps``); returns
+    ``{uid: prompt+generated}`` for every request finished during the
+    call."""
+    out: Dict[Any, np.ndarray] = {}
+    steps = 0
+    while self.has_work and (max_steps is None or steps < max_steps):
+      for fin in self.step():
+        out[fin.uid] = fin.tokens
+      steps += 1
+    return out

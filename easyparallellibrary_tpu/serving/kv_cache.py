@@ -1,0 +1,157 @@
+"""Slot-based KV cache for continuous-batching inference.
+
+The serving engine's memory plan is vLLM's insight shrunk to one level:
+instead of allocating a fresh ``[B, max_seq_len, H, hd]`` cache per
+``generate()`` call (models/gpt.py legacy decode), ONE cache of
+``num_slots`` request slots is allocated at engine start and reused for
+the life of the server.  A slot is the unit of admission: a request owns
+exactly one slot from admission to retirement, its write offset tracked
+by a per-slot cursor (the cursor *vector* models/gpt.py's
+``slot_cache_attend`` consumes).  Eviction is free-list bookkeeping on
+the host — no device work: stale K/V left by the previous occupant is
+never attendable because the mask only exposes positions the current
+request's own tokens have written (see slot_cache_attend's docstring;
+tests/test_serving.py asserts the no-leakage property).
+
+Placement: the cache is materialized directly into its sharded layout on
+the mesh (same jit-with-out-shardings trick as
+``create_sharded_train_state``), heads sharded over the tensor-parallel
+``model`` axis so each TP shard holds exactly the head slice its
+column-parallel QKV produces — cache reads/writes stay local, and GSPMD
+inserts no resharding around the attention.
+
+Layout note: the per-slot length is ``max_seq_len + chunk``
+(:func:`cache_length`), one chunk longer than any request can grow.  The
+fused step unconditionally writes a full ``chunk``-wide K/V window at
+every slot's cursor (static shapes — masking, not shape, expresses
+partial validity), so the window must never clamp against the end of the
+buffer; ``jax.lax.dynamic_update_slice`` would otherwise shift the write
+and corrupt earlier positions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from easyparallellibrary_tpu import constants
+
+
+def cache_length(cfg, chunk: int) -> int:
+  """Per-slot cache length: ``max_seq_len`` plus one chunk of slack so
+  the fused step's fixed-width write window never clamps (module
+  docstring)."""
+  return cfg.max_seq_len + int(chunk)
+
+
+def kv_spec() -> P:
+  """PartitionSpec of one cache leaf ``[num_slots, Lc, H, hd]``: heads
+  over the TP axis, slots/positions replicated."""
+  return P(None, None, constants.MODEL_AXIS, None)
+
+
+def kv_cache_shardings(cfg, mesh: Optional[Mesh]):
+  """(kv_shardings_pytree, cursor_sharding) matching
+  :func:`allocate_kv_cache`'s structure, or (None, None) without a mesh.
+
+  Heads shard over ``model`` only when the cache's head count actually
+  divides the axis; otherwise the cache is replicated (a 1-sized or
+  absent model axis degrades to replication anyway).
+  """
+  if mesh is None:
+    return None, None
+  sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+  tp = sizes.get(constants.MODEL_AXIS, 1)
+  spec = kv_spec() if tp > 1 and cfg.num_heads % tp == 0 else P()
+  leaf = NamedSharding(mesh, spec)
+  kv = {f"block_{i}": {"attn": {"cached_key": leaf, "cached_value": leaf}}
+        for i in range(cfg.num_layers)}
+  return kv, NamedSharding(mesh, P())
+
+
+def allocate_kv_cache(cfg, num_slots: int, chunk: int,
+                      mesh: Optional[Mesh] = None
+                      ) -> Tuple[Dict[str, Any], jax.Array]:
+  """Preallocate the slot cache for a GPT config.
+
+  Returns ``(kv, cursors)``: ``kv`` is a pytree shaped exactly like the
+  ``"cache"`` collection GPT's slot-mode decode reads/writes
+  (``{"block_i": {"attn": {"cached_key"/"cached_value":
+  [num_slots, Lc, H, hd]}}}``), ``cursors`` the int32 ``[num_slots]``
+  write-offset vector (all zero).  With a mesh, every leaf materializes
+  already sharded (jit + out_shardings — no host-memory spike, no
+  transfer).
+  """
+  if num_slots < 1:
+    raise ValueError(f"num_slots must be >= 1: {num_slots}")
+  if chunk < 1:
+    raise ValueError(f"prefill chunk must be >= 1: {chunk}")
+  if cfg.d_model % cfg.num_heads:
+    raise ValueError(f"d_model {cfg.d_model} must divide into "
+                     f"{cfg.num_heads} heads")
+  H, hd = cfg.num_heads, cfg.d_model // cfg.num_heads
+  Lc = cache_length(cfg, chunk)
+  shape = (num_slots, Lc, H, hd)
+  kv_shardings, cur_sharding = kv_cache_shardings(cfg, mesh)
+
+  def build():
+    leaf = lambda: jnp.zeros(shape, cfg.dtype)
+    kv = {f"block_{i}": {"attn": {"cached_key": leaf(),
+                                  "cached_value": leaf()}}
+          for i in range(cfg.num_layers)}
+    return kv, jnp.zeros((num_slots,), jnp.int32)
+
+  if kv_shardings is None:
+    return jax.jit(build)()
+  return jax.jit(build, out_shardings=(kv_shardings, cur_sharding))()
+
+
+def cache_bytes(cfg, num_slots: int, chunk: int) -> int:
+  """Total cache footprint in bytes (both K and V, all layers) — the
+  number the admission knobs trade against HBM."""
+  H, hd = cfg.num_heads, cfg.d_model // cfg.num_heads
+  per_leaf = num_slots * cache_length(cfg, chunk) * H * hd
+  return 2 * cfg.num_layers * per_leaf * jnp.dtype(cfg.dtype).itemsize
+
+
+class SlotAllocator:
+  """Host-side free-list over the cache's request slots.
+
+  Lowest-free-first allocation keeps slot assignment deterministic for a
+  given request order (exactness tests replay schedules).  Freeing does
+  no device work: the cache mask makes stale K/V unreachable, so
+  "eviction" is purely returning the slot id to the list.
+  """
+
+  def __init__(self, num_slots: int):
+    if num_slots < 1:
+      raise ValueError(f"num_slots must be >= 1: {num_slots}")
+    self.num_slots = num_slots
+    self._free: List[int] = list(range(num_slots))
+    self._used = set()
+
+  @property
+  def num_free(self) -> int:
+    return len(self._free)
+
+  def alloc(self) -> Optional[int]:
+    """Claim the lowest free slot, or None when full."""
+    if not self._free:
+      return None
+    slot = min(self._free)
+    self._free.remove(slot)
+    self._used.add(slot)
+    return slot
+
+  def free(self, slot: int):
+    if slot not in self._used:
+      raise ValueError(f"slot {slot} is not allocated (double free?)")
+    self._used.remove(slot)
+    self._free.append(slot)
+
+  def __repr__(self):
+    return (f"SlotAllocator(num_slots={self.num_slots}, "
+            f"free={sorted(self._free)})")

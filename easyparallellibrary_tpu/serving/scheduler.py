@@ -1,0 +1,305 @@
+"""Host-side request scheduling for the continuous-batching engine.
+
+Iteration-level (continuous) batching as in Orca (OSDI'22): the
+scheduler re-forms the working set EVERY engine step, so requests join
+the moment a slot frees and leave the moment they finish — no
+batch-formation wait, no decode steps wasted running finished requests
+to a batch-wide horizon.  The device program never changes shape; all of
+the variability lives here, in which tokens each slot is fed.
+
+Responsibilities (and nothing else — device work lives in engine.py):
+
+* FCFS admission, gated by free slots, a configurable concurrent-batch
+  cap (``max_batch``) and a per-iteration prefill-token budget that
+  bounds how much prompt work any single step may carry
+  (Sarathi-style chunked prefill: long prompts stream through the fused
+  step ``prefill_chunk`` tokens at a time, so admission never stalls
+  decode latency for more than one chunk).
+* Per-request decode state: prompt cursor, generated tokens, per-request
+  RNG stream (a dedicated PRNGKey folded with the token index — two
+  requests with the same seed reproduce the same sample stream no
+  matter which slots or iterations they ride).
+* Retirement: per-request ``max_new_tokens`` and optional stop-token,
+  plus the hard ``max_seq_len`` capacity guard (checked at submit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import zlib
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+  """One generation request.
+
+  ``prompt`` is a 1-D int32 token array (non-empty — the model
+  conditions the first new token on it, exactly like ``generate()``).
+  ``temperature<=0`` is greedy; ``top_k``/``top_p`` mirror
+  ``sample_logits`` semantics per slot.  ``stop_token < 0`` disables
+  stop-token retirement; when hit, the stop token IS included in the
+  output (the caller sees why the request ended).  ``seed`` starts the
+  request's private RNG stream (defaults to a hash of ``uid``).
+  """
+  uid: Any
+  prompt: np.ndarray
+  max_new_tokens: int
+  temperature: float = 0.0
+  top_k: int = 0
+  top_p: float = 1.0
+  stop_token: int = -1
+  seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class FinishedRequest:
+  uid: Any
+  tokens: np.ndarray          # prompt + generated (stop token included)
+  new_tokens: int
+  finish_reason: str          # "length" | "stop_token"
+
+
+@dataclasses.dataclass
+class StepPlan:
+  """Device-ready arrays for one fused engine step (all [N] or [N, C])."""
+  tokens: np.ndarray          # int32 [N, C] token chunk per slot
+  num_valid: np.ndarray       # int32 [N]   live tokens in the chunk
+  reset: np.ndarray           # bool  [N]   zero the cursor (fresh slot)
+  keys: np.ndarray            # uint32 [N, 2] per-request PRNG keys
+  tok_index: np.ndarray       # int32 [N]   tokens generated so far
+  temperature: np.ndarray     # f32   [N]
+  top_k: np.ndarray           # int32 [N]
+  top_p: np.ndarray           # f32   [N]
+  prefill_tokens: int         # scheduled prompt tokens this step
+  decode_tokens: int          # scheduled decode tokens this step
+  active_slots: int
+
+
+class _SlotState:
+  """Host mirror of one occupied slot."""
+
+  __slots__ = ("req", "slot", "prompt_pos", "generated", "key",
+               "admitted_at", "first_token_at")
+
+  def __init__(self, req: Request, slot: int):
+    self.req = req
+    self.slot = slot
+    self.prompt_pos = 0                    # prompt tokens already fed
+    self.generated: List[int] = []
+    if req.seed is not None:
+      seed = req.seed
+    else:
+      # Stable across processes (Python's hash() is salted per process,
+      # which would make a restarted server sample different streams
+      # for the same uid).
+      seed = zlib.crc32(str(req.uid).encode())
+    self.key = np.asarray(jax.random.PRNGKey(seed))
+    self.admitted_at = time.monotonic()
+    self.first_token_at: Optional[float] = None
+
+  @property
+  def prefilling(self) -> bool:
+    return self.prompt_pos < len(self.req.prompt)
+
+
+class FCFSScheduler:
+  """First-come-first-served continuous-batching scheduler.
+
+  ``plan_step()`` builds the next fused-step inputs (admitting new
+  requests as slots and budget allow); ``commit(next_tokens)`` folds the
+  step's sampled tokens back into per-request state and returns the
+  requests that retired.  The engine owns the device half of the loop.
+  """
+
+  def __init__(self, num_slots: int, prefill_chunk: int,
+               max_seq_len: int, prefill_token_budget: int = 0,
+               max_batch: int = 0, stop_token: int = -1):
+    from easyparallellibrary_tpu.serving.kv_cache import SlotAllocator
+    if prefill_chunk < 1:
+      raise ValueError(f"prefill_chunk must be >= 1: {prefill_chunk}")
+    if prefill_token_budget < 0 or max_batch < 0:
+      raise ValueError("prefill_token_budget and max_batch must be >= 0")
+    self.num_slots = num_slots
+    self.chunk = prefill_chunk
+    self.max_seq_len = max_seq_len
+    # 0 = uncapped: every prefilling slot gets a full chunk each step.
+    self.prefill_token_budget = prefill_token_budget
+    self.max_batch = max_batch if max_batch > 0 else num_slots
+    self.default_stop_token = stop_token
+    self.allocator = SlotAllocator(num_slots)
+    self.pending: Deque[Request] = deque()
+    self.active: Dict[int, _SlotState] = {}   # slot -> state
+    self._admit_order: List[int] = []         # slots, admission order
+    self._plan: Optional[StepPlan] = None
+    self.on_admit = None                      # hooks: fn(uid)
+    self.on_first_token = None                # fn(uid)
+    self.on_finish = None                     # fn(FinishedRequest)
+
+  # ---------------------------------------------------------------- queue
+
+  def submit(self, req: Request):
+    """Validate and enqueue (FCFS).  Mirrors ``generate()``'s argument
+    validation so a request the engine accepts can always run."""
+    prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+    if prompt.size == 0:
+      raise ValueError("request needs a non-empty prompt (at least a BOS "
+                       "token) — same contract as generate()")
+    if req.max_new_tokens < 1:
+      raise ValueError(f"max_new_tokens must be >= 1: {req.max_new_tokens}")
+    total = prompt.size + req.max_new_tokens
+    if total > self.max_seq_len:
+      raise ValueError(f"prompt + new tokens ({total}) exceeds "
+                       f"max_seq_len {self.max_seq_len}")
+    if not 0.0 < req.top_p <= 1.0:
+      raise ValueError(f"top_p must be in (0, 1]: {req.top_p}")
+    if req.top_k < 0:
+      raise ValueError(f"top_k must be >= 0: {req.top_k}")
+    req = dataclasses.replace(req, prompt=prompt)
+    if req.stop_token < 0 and self.default_stop_token >= 0:
+      req = dataclasses.replace(req, stop_token=self.default_stop_token)
+    self.pending.append(req)
+
+  @property
+  def has_work(self) -> bool:
+    return bool(self.pending or self.active)
+
+  @property
+  def num_active(self) -> int:
+    return len(self.active)
+
+  # ----------------------------------------------------------------- plan
+
+  def _admit(self) -> None:
+    """Admit pending requests FCFS while slots, the batch cap and the
+    prefill budget allow.  The budget is charged for each admission's
+    first chunk so one step never admits more prefill work than it can
+    schedule — an admitted-but-starved request would hold a slot while
+    contributing nothing."""
+    budget_left = self.prefill_token_budget
+    if budget_left > 0:
+      # Already-active prefill slots have first claim on the budget.
+      budget_left -= sum(
+          min(self.chunk, len(s.req.prompt) - s.prompt_pos)
+          for s in self.active.values() if s.prefilling)
+    while (self.pending and self.allocator.num_free > 0
+           and len(self.active) < self.max_batch):
+      first_chunk = min(self.chunk, len(self.pending[0].prompt))
+      if self.prefill_token_budget > 0 and budget_left < first_chunk:
+        break
+      budget_left -= first_chunk
+      req = self.pending.popleft()
+      slot = self.allocator.alloc()
+      self.active[slot] = _SlotState(req, slot)
+      self._admit_order.append(slot)
+      if self.on_admit:
+        self.on_admit(req.uid)
+
+  def plan_step(self) -> Optional[StepPlan]:
+    """Build the next fused step's inputs, or None when idle.
+
+    Budgeting: decode slots always get their one token (decode latency
+    is the metric continuous batching protects); prefill chunks are
+    granted FCFS in admission order until the per-step budget runs out —
+    a starved prefill slot simply carries ``num_valid=0`` this step and
+    resumes next step.
+    """
+    self._admit()
+    if not self.active:
+      self._plan = None
+      return None
+    N, C = self.num_slots, self.chunk
+    plan = StepPlan(
+        tokens=np.zeros((N, C), np.int32),
+        num_valid=np.zeros((N,), np.int32),
+        reset=np.zeros((N,), bool),
+        keys=np.zeros((N, 2), np.uint32),
+        tok_index=np.zeros((N,), np.int32),
+        temperature=np.zeros((N,), np.float32),
+        top_k=np.zeros((N,), np.int32),
+        top_p=np.ones((N,), np.float32),
+        prefill_tokens=0, decode_tokens=0,
+        active_slots=len(self.active))
+    budget = self.prefill_token_budget
+    for slot in self._admit_order:
+      state = self.active.get(slot)
+      if state is None:
+        continue
+      req = state.req
+      plan.keys[slot] = state.key
+      plan.tok_index[slot] = len(state.generated)
+      plan.temperature[slot] = req.temperature
+      plan.top_k[slot] = req.top_k
+      plan.top_p[slot] = req.top_p
+      plan.reset[slot] = state.prompt_pos == 0 and not state.generated
+      if state.prefilling:
+        remaining = len(req.prompt) - state.prompt_pos
+        grant = min(C, remaining)
+        if budget > 0:
+          grant = min(grant, max(budget - plan.prefill_tokens, 0))
+        if grant == 0:
+          continue  # budget-starved this step; resumes next step
+        chunk = req.prompt[state.prompt_pos:state.prompt_pos + grant]
+        plan.tokens[slot, :grant] = chunk
+        plan.num_valid[slot] = grant
+        plan.prefill_tokens += grant
+      else:
+        plan.tokens[slot, 0] = state.generated[-1]
+        plan.num_valid[slot] = 1
+        plan.decode_tokens += 1
+    self._plan = plan
+    return plan
+
+  # --------------------------------------------------------------- commit
+
+  def _retire(self, state: _SlotState, reason: str) -> FinishedRequest:
+    slot = state.slot
+    del self.active[slot]
+    self._admit_order.remove(slot)
+    self.allocator.free(slot)
+    fin = FinishedRequest(
+        uid=state.req.uid,
+        tokens=np.concatenate(
+            [state.req.prompt,
+             np.asarray(state.generated, np.int32)]),
+        new_tokens=len(state.generated),
+        finish_reason=reason)
+    if self.on_finish:
+      self.on_finish(fin)
+    return fin
+
+  def commit(self, next_tokens: np.ndarray) -> List[FinishedRequest]:
+    """Fold one step's sampled tokens ``[N]`` back into request state;
+    returns retirements.  A slot's sampled token only counts when its
+    prompt is fully consumed — mid-prefill samples are positions whose
+    "next token" is still dictated by the prompt."""
+    if self._plan is None:
+      raise RuntimeError("commit() without a preceding plan_step()")
+    plan, self._plan = self._plan, None
+    finished: List[FinishedRequest] = []
+    now = time.monotonic()
+    for slot in list(self._admit_order):
+      state = self.active.get(slot)
+      if state is None or plan.num_valid[slot] == 0:
+        continue
+      req = state.req
+      was_prefilling = state.prefilling
+      if was_prefilling:
+        state.prompt_pos += int(plan.num_valid[slot])
+        if state.prefilling:
+          continue  # more prompt to feed; discard the sample
+        state.first_token_at = now
+        if self.on_first_token:
+          self.on_first_token(req.uid)
+      tok = int(next_tokens[slot])
+      state.generated.append(tok)
+      if req.stop_token >= 0 and tok == req.stop_token:
+        finished.append(self._retire(state, "stop_token"))
+      elif len(state.generated) >= req.max_new_tokens:
+        finished.append(self._retire(state, "length"))
+    return finished
