@@ -20,7 +20,12 @@ chaos:
 serve-bench:
 	python benchmarks/decode_throughput.py
 
+# Speculative vs plain decode on repetitive/incompressible traces
+# (benchmarks/speculative_decode.py -> BENCH_EVIDENCE.json; docs/serving.md).
+spec-bench:
+	python benchmarks/speculative_decode.py
+
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test bench chaos serve-bench clean
+.PHONY: all build test bench chaos serve-bench spec-bench clean

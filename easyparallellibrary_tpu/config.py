@@ -68,14 +68,25 @@ class _Category:
   _name = ""
 
   def __init__(self, overrides: Dict[str, Any]):
+    # Sub-group fields are dotted ("speculative.enabled"); accept the
+    # equivalent nested-dict override form {"speculative": {"enabled": 1}}.
+    flat: Dict[str, Any] = {}
+    for key, value in overrides.items():
+      if isinstance(value, dict):
+        for sub_key, sub_value in value.items():
+          flat[f"{key}.{sub_key}"] = sub_value
+      else:
+        flat[key] = value
+    overrides = flat
     unknown = set(overrides) - set(self._fields)
     if unknown:
       raise ValueError(
           f"Unknown config key(s) {sorted(unknown)} in category "
           f"'{self._name}'. Valid keys: {sorted(self._fields)}")
     for key, default in self._fields.items():
+      env_key = (f"{constants.ENV_PREFIX}_{self._name.upper()}_"
+                 f"{key.upper().replace('.', '_')}")
       value = default
-      env_key = f"{constants.ENV_PREFIX}_{self._name.upper()}_{key.upper()}"
       if env_key in os.environ:
         value = _coerce(os.environ[env_key], default, env_key)
       if key in overrides:
@@ -96,6 +107,30 @@ class _Category:
   def __repr__(self):
     inner = ", ".join(f"{k}={getattr(self, k)!r}" for k in self._fields)
     return f"{type(self).__name__}({inner})"
+
+
+class _SubGroup:
+  """Attribute view over a category's dotted sub-group fields, so
+  ``config.serving.speculative.enabled`` reads/writes the flat
+  ``serving`` field ``"speculative.enabled"`` with the category's own
+  coercion and unknown-key protection."""
+
+  def __init__(self, category: _Category, prefix: str):
+    object.__setattr__(self, "_category", category)
+    object.__setattr__(self, "_prefix", prefix)
+
+  def __getattr__(self, key: str) -> Any:
+    return getattr(self._category, f"{self._prefix}.{key}")
+
+  def __setattr__(self, key: str, value: Any):
+    setattr(self._category, f"{self._prefix}.{key}", value)
+
+  def __repr__(self):
+    cat = self._category
+    inner = ", ".join(
+        f"{k.split('.', 1)[1]}={getattr(cat, k)!r}"
+        for k in cat._fields if k.startswith(self._prefix + "."))
+    return f"{type(cat).__name__}.{self._prefix}({inner})"
 
 
 class AutoParallelConfig(_Category):
@@ -375,7 +410,27 @@ class ServingConfig(_Category):
       # update; steady-state device allocation = one cache).  Turn off
       # only for debugging (keeps every step's input cache alive).
       "donate_cache": True,
+      # --- speculative decoding (serving/speculative/, docs/serving.md).
+      # Draft k tokens per decode slot and verify them in the SAME fused
+      # step (the drafts ride chunk positions plain decode wastes), so
+      # an accepted draft is a free committed token.  Off by default:
+      # speculation changes sampled streams (never their distribution).
+      "speculative.enabled": False,
+      # Draft tokens per decode slot per step; the fused step needs
+      # prefill_chunk >= k + 1 (k drafts + the last committed token).
+      "speculative.k": 4,
+      # Drafter: "ngram" (prompt-lookup over each request's committed
+      # history — no extra weights) or "draft_model" (a small GPT passed
+      # to the engine / DraftModelDrafter.from_checkpoint).
+      "speculative.kind": "ngram",
+      # Longest/shortest history suffix the n-gram drafter matches.
+      "speculative.ngram_max": 4,
+      "speculative.ngram_min": 1,
   }
+
+  @property
+  def speculative(self) -> _SubGroup:
+    return _SubGroup(self, "speculative")
 
 
 class Config:
@@ -520,6 +575,23 @@ class Config:
     if self.serving.stop_token < -1:
       raise ValueError(f"serving.stop_token must be >= -1; "
                        f"got {self.serving.stop_token}")
+    spec = self.serving.speculative
+    if spec.k < 1:
+      raise ValueError(
+          f"serving.speculative.k must be >= 1; got {spec.k}")
+    if spec.kind not in ("ngram", "draft_model"):
+      raise ValueError("serving.speculative.kind must be 'ngram' or "
+                       f"'draft_model'; got {spec.kind!r}")
+    if not 1 <= spec.ngram_min <= spec.ngram_max:
+      raise ValueError(
+          "serving.speculative needs 1 <= ngram_min <= ngram_max; got "
+          f"ngram_min={spec.ngram_min}, ngram_max={spec.ngram_max}")
+    if spec.enabled and spec.k + 1 > self.serving.prefill_chunk:
+      raise ValueError(
+          f"serving.speculative.k={spec.k} needs serving.prefill_chunk "
+          f">= k + 1 (the fused step carries each decode slot's last "
+          f"committed token plus its k drafts in one chunk); got "
+          f"prefill_chunk {self.serving.prefill_chunk}")
 
   def to_dict(self) -> Dict[str, Dict[str, Any]]:
     return {c._name: getattr(self, c._name).to_dict()

@@ -152,6 +152,34 @@ def slot_cache_attend(q, k, v, cached_k, cached_v, cursors, dtype):
   return out, cached_k, cached_v
 
 
+def slot_step_logits(model, params, kv, tokens, cursors):
+  """Multi-token scoring on the shared slot-cache core — THE device entry
+  every serving component steps through.
+
+  One call scores ``tokens`` (int32 ``[num_slots, C]``, any chunk width
+  C >= 1) against the slot KV cache: token ``i`` of slot ``b`` lands at
+  absolute position ``cursors[b] + i``, attends its own causal prefix
+  (:func:`slot_cache_attend`), and position ``i``'s logits are the
+  model's distribution for the token at ``cursors[b] + i + 1``.  That
+  makes the call serve three roles with identical numerics:
+
+  * chunked **prefill** (C prompt tokens per slot),
+  * one-token **decode** (C == 1, or one valid token in a wider chunk),
+  * batched **verification** of speculative drafts — k drafted tokens
+    ride the chunk positions plain decode wastes, and their k+1 target
+    distributions come back in the same call
+    (serving/speculative/verify.py).
+
+  Returns ``(logits [num_slots, C, vocab], new_kv)``; the caller owns
+  cursor advancement (and, for speculation, rollback to the last
+  accepted position).
+  """
+  logits, mut = model.apply(
+      {"params": params, "cache": kv}, tokens, decode=True,
+      slot_cursors=cursors, mutable=["cache"])
+  return logits, mut["cache"]
+
+
 def _missing_slot_cache():
   raise ValueError(
       "slot-mode decode (slot_cursors=...) needs an externally allocated "

@@ -14,7 +14,13 @@ standard serving quartet:
   requests;
 * **slot occupancy** — mean fraction of KV-cache slots doing work per
   step (how full the continuous batch actually runs; low occupancy with
-  a deep queue means admission is the bottleneck).
+  a deep queue means admission is the bottleneck);
+* **speculation** — drafted vs accepted draft tokens, overall acceptance
+  rate, and accepted-tokens-per-step percentiles over the steps that
+  actually drafted (docs/serving.md "Speculative decoding").  Early in a
+  run — or on a non-speculative engine — that window is legitimately
+  empty or a single sample; every rollup degrades gracefully to 0.0 /
+  the lone sample rather than raising.
 
 The engine feeds these via the ``note_*`` hooks; ``summary()`` rolls
 them up for logs / ``MetricsWriter`` / BENCH_EVIDENCE records.  Host
@@ -29,11 +35,14 @@ from typing import Any, Dict, List, Optional
 
 
 def percentile(values: List[float], q: float) -> float:
-  """Nearest-rank percentile (q in [0, 100]); 0.0 on empty input.  Kept
-  dependency-free and deterministic — benchmark records must not drift
-  with numpy interpolation-mode defaults."""
+  """Nearest-rank percentile; 0.0 on empty input, the lone sample on a
+  1-element window, and ``q`` clamped into [0, 100] — small windows are
+  legitimate (acceptance-rate rollups start empty), so no input here
+  ever raises.  Kept dependency-free and deterministic — benchmark
+  records must not drift with numpy interpolation-mode defaults."""
   if not values:
     return 0.0
+  q = max(0.0, min(100.0, float(q)))
   xs = sorted(values)
   rank = max(0, min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1)))))
   return float(xs[rank])
@@ -74,6 +83,12 @@ class ServingStats:
     self.finished_requests = 0
     self.generated_tokens = 0
     self._occupancy_sum = 0.0
+    self.drafted_tokens = 0
+    self.accepted_tokens = 0
+    # Accepted drafts per step, recorded only for steps that drafted —
+    # legitimately empty early in a run (all-prefill steps) or on a
+    # non-speculative engine.
+    self._accepted_per_step: List[float] = []
 
   # ------------------------------------------------------------ lifecycle
 
@@ -99,12 +114,17 @@ class ServingStats:
 
   def note_step(self, active_slots: int, num_slots: int,
                 prefill_tokens: int, decode_tokens: int,
-                step_time_s: float):
+                step_time_s: float, drafted_tokens: int = 0,
+                accepted_tokens: int = 0):
     self.steps += 1
     self.busy_time_s += step_time_s
     self.prefill_tokens += prefill_tokens
     self.decode_tokens += decode_tokens
     self._occupancy_sum += active_slots / max(num_slots, 1)
+    if drafted_tokens > 0:
+      self.drafted_tokens += int(drafted_tokens)
+      self.accepted_tokens += int(accepted_tokens)
+      self._accepted_per_step.append(float(accepted_tokens))
 
   # -------------------------------------------------------------- rollup
 
@@ -127,6 +147,7 @@ class ServingStats:
   def summary(self) -> Dict[str, float]:
     ttfts, itls = self._ttfts(), self._itls()
     busy = max(self.busy_time_s, 1e-9)
+    acc = self._accepted_per_step
     return {
         "steps": float(self.steps),
         "finished_requests": float(self.finished_requests),
@@ -140,4 +161,14 @@ class ServingStats:
         "itl_p99_s": percentile(itls, 99),
         "slot_occupancy_mean": (self._occupancy_sum / self.steps
                                 if self.steps else 0.0),
+        # Speculation (all 0.0 on a non-speculative engine): drafted vs
+        # accepted totals, overall acceptance rate, and accepted-per-
+        # step percentiles over the steps that drafted.
+        "drafted_tokens": float(self.drafted_tokens),
+        "accepted_tokens": float(self.accepted_tokens),
+        "acceptance_rate": (self.accepted_tokens / self.drafted_tokens
+                            if self.drafted_tokens else 0.0),
+        "accepted_per_step_mean": (sum(acc) / len(acc)) if acc else 0.0,
+        "accepted_per_step_p50": percentile(acc, 50),
+        "accepted_per_step_p99": percentile(acc, 99),
     }

@@ -572,6 +572,30 @@ def _restore_from(directory: str,
   return restored, index.get("step")
 
 
+def peek_leaf_shapes(directory: str
+                     ) -> Tuple[Dict[str, Tuple[int, ...]], int]:
+  """Leaf-name → stored shape of the newest VALID checkpoint, from its
+  index alone — no shard data is read.
+
+  Walks the same checksum-validated newest-first chain as
+  :func:`restore_checkpoint` (corrupt candidates quarantined and
+  skipped), so the shapes describe the checkpoint a subsequent restore
+  would actually load.  Serving uses this to validate a draft model's
+  compatibility (vocabulary width, serving/speculative/drafter.py)
+  BEFORE paying for the restore — a shape mismatch then fails in
+  milliseconds with an actionable message instead of a pytree error
+  mid-load.  Returns ``({path: shape}, step)``; raises
+  ``FileNotFoundError`` when no valid checkpoint exists.
+  """
+  for path in _walk_valid_checkpoints(directory):
+    with open(os.path.join(path, INDEX_FILE)) as f:
+      index = json.load(f)
+    shapes = {p: tuple(info.get("shape", ()))
+              for p, info in index["leaves"].items()}
+    return shapes, int(index.get("step", 0))
+  raise FileNotFoundError(f"no valid checkpoint under {directory!r}")
+
+
 def restore_params(directory: str,
                    target=None,
                    shardings=None,

@@ -2,13 +2,18 @@
 
 The fourth runtime mode (train / eval / generate / serve): a slot-based
 preallocated KV cache (:mod:`kv_cache`), a host-side FCFS scheduler with
-chunked-prefill admission (:mod:`scheduler`), and a single-jitted-step
+chunked-prefill admission (:mod:`scheduler`), a single-jitted-step
 engine that fuses prefill and decode so requests join and leave the
-batch every iteration (:mod:`engine`).  See docs/serving.md.
+batch every iteration (:mod:`engine`), and speculative decoding —
+drafters plus batched verification with per-slot accept/rollback riding
+that same step (:mod:`speculative`).  See docs/serving.md.
 """
 
+from easyparallellibrary_tpu.serving._capabilities import (
+    check_draft_compatible, check_servable,
+)
 from easyparallellibrary_tpu.serving.engine import (
-    ContinuousBatchingEngine, sample_token_slots,
+    ContinuousBatchingEngine, filtered_logits, sample_token_slots,
 )
 from easyparallellibrary_tpu.serving.kv_cache import (
     SlotAllocator, allocate_kv_cache, cache_bytes, cache_length,
@@ -17,10 +22,17 @@ from easyparallellibrary_tpu.serving.kv_cache import (
 from easyparallellibrary_tpu.serving.scheduler import (
     FCFSScheduler, FinishedRequest, Request, StepPlan,
 )
+from easyparallellibrary_tpu.serving.speculative import (
+    Drafter, DraftModelDrafter, NgramDrafter, ngram_propose,
+    verify_tokens,
+)
 
 __all__ = [
-    "ContinuousBatchingEngine", "sample_token_slots",
+    "ContinuousBatchingEngine", "filtered_logits", "sample_token_slots",
     "SlotAllocator", "allocate_kv_cache", "cache_bytes", "cache_length",
     "kv_cache_shardings",
     "FCFSScheduler", "FinishedRequest", "Request", "StepPlan",
+    "check_draft_compatible", "check_servable",
+    "Drafter", "DraftModelDrafter", "NgramDrafter", "ngram_propose",
+    "verify_tokens",
 ]

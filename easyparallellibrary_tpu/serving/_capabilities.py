@@ -1,0 +1,84 @@
+"""Unified serving capability guards.
+
+One site answers "can this config serve?" so the engine, the drafters
+and any future serving component reject an unsupported composition with
+the SAME actionable message — each pointing at the ROADMAP open item
+that will lift the limit, instead of three slightly different inline
+raises that drift apart (the PR-3 engine carried two of these inline;
+speculative decoding would have added a third family).
+
+Everything here is a pure check: no imports of the engine, no device
+work, safe to call before any allocation.
+"""
+
+from __future__ import annotations
+
+ROADMAP_PP_SERVING = (
+    "pipeline-parallel serving is a ROADMAP open item ('Pipeline-parallel "
+    "serving'; docs/serving.md 'Current limits')")
+ROADMAP_MOE_SERVING = (
+    "MoE serving (expert-parallel decode) is a ROADMAP open item "
+    "('MoE serving'; docs/serving.md 'Current limits')")
+ROADMAP_DRAFT_DISTILL = (
+    "training a matched drafter is a ROADMAP follow-up ('draft-model "
+    "distillation'; docs/serving.md 'Speculative decoding')")
+
+
+def check_servable(cfg, role: str = "the serving engine") -> None:
+  """Reject model configs the serving stack cannot run.
+
+  ``cfg`` is a :class:`models.gpt.GPTConfig` (or anything exposing
+  ``pipeline_stages`` / ``num_experts``); ``role`` names the component
+  doing the rejecting so a draft-model failure reads differently from a
+  target-model one.
+  """
+  if cfg.pipeline_stages > 1:
+    raise ValueError(
+        f"{role} is single-program (pipeline_stages=1) but got "
+        f"pipeline_stages={cfg.pipeline_stages}; restore the checkpoint "
+        f"into a non-pipelined config (runtime.saver.restore_params) — "
+        f"{ROADMAP_PP_SERVING}")
+  if cfg.num_experts > 0:
+    raise ValueError(
+        f"{role} does not support MoE checkpoints yet "
+        f"(num_experts={cfg.num_experts}); restore a dense checkpoint — "
+        f"{ROADMAP_MOE_SERVING}")
+
+
+def check_draft_compatible(target_cfg, draft_cfg) -> None:
+  """Reject draft models whose shapes cannot verify against the target.
+
+  The verify step compares token ids, so the two models must share one
+  vocabulary; the draft slot cache must cover every committed position a
+  request can reach, so the draft ``max_seq_len`` must be at least the
+  target's.  Everything else (depth, width, heads) is free to differ —
+  that asymmetry is the whole point of a drafter.
+  """
+  check_servable(draft_cfg, role="a draft model")
+  if draft_cfg.vocab_size != target_cfg.vocab_size:
+    raise ValueError(
+        f"draft model vocab_size {draft_cfg.vocab_size} != target "
+        f"vocab_size {target_cfg.vocab_size}: speculative verification "
+        f"compares token ids under one vocabulary; use a drafter trained "
+        f"on the target tokenizer — {ROADMAP_DRAFT_DISTILL}")
+  if draft_cfg.max_seq_len < target_cfg.max_seq_len:
+    raise ValueError(
+        f"draft model max_seq_len {draft_cfg.max_seq_len} < target "
+        f"max_seq_len {target_cfg.max_seq_len}: the draft slot cache "
+        f"must cover every position a request can commit (requests are "
+        f"admitted against the target's max_seq_len); pad the draft "
+        f"config's max_seq_len up to the target's")
+
+
+def check_draft_fits_chunk(k: int, chunk: int) -> None:
+  """The fused step carries each decode slot's last committed token plus
+  its ``k`` drafts in one ``chunk``-wide block; reject a drafter the
+  step could never schedule."""
+  if k < 1:
+    raise ValueError(f"speculative draft length k must be >= 1; got {k}")
+  if k + 1 > chunk:
+    raise ValueError(
+        f"speculative draft length k={k} needs prefill_chunk >= k + 1 "
+        f"(one chunk holds the last committed token plus the drafts); "
+        f"got prefill_chunk {chunk} — raise serving.prefill_chunk or "
+        f"lower serving.speculative.k")

@@ -22,10 +22,21 @@ parameter twin of ``sample_logits``) with per-request keys folded by
 token index, so a request's sample stream is independent of which slot
 or iteration serves it.
 
+Speculative decoding (serving/speculative/) rides the same fused step:
+a drafter fills each decode slot's unused chunk positions with ``k``
+guessed tokens, the one model call scores all of them (verification is
+a prefill-shaped call — nearly free in this step), and in-jit per-slot
+accept/rollback commits the accepted prefix plus one correction/bonus
+token, rolling cursors back to the last accepted position.  Toggled by
+``serving.speculative.*`` / per-request ``Request.speculative``.
+
 Exactness contract: greedy engine output is bit-identical (token ids)
 to ``generate(use_cache=True)`` per request — the legacy path stays the
 oracle (tests/test_serving.py), including requests admitted mid-flight
-and slots reused after retirement.
+and slots reused after retirement.  Greedy SPECULATIVE output keeps the
+same contract (exact-match acceptance); sampled speculative output
+keeps the sampling distribution, not the bitstream
+(tests/test_serving_speculative.py).
 """
 
 from __future__ import annotations
@@ -39,25 +50,27 @@ import numpy as np
 
 from easyparallellibrary_tpu.env import Env
 from easyparallellibrary_tpu.serving import kv_cache as kv_lib
+from easyparallellibrary_tpu.serving._capabilities import (
+    check_draft_fits_chunk, check_servable)
 from easyparallellibrary_tpu.serving.scheduler import (
     FCFSScheduler, FinishedRequest, Request)
 from easyparallellibrary_tpu.utils.logging import get_logger
 
 
-def sample_token_slots(logits, keys, temperature, top_k, top_p):
-  """Per-slot sampling with TRACED parameters — the vectorized twin of
-  ``models.gpt.sample_logits`` (same filter semantics and order: top-k,
-  then top-p over the survivors; ``temperature<=0`` is greedy), for the
-  serving step where every slot carries its own sampling knobs and every
-  value must be an array (static per-request values would recompile the
-  fused step per parameter combination).
+def filtered_logits(logits, temperature, top_k, top_p):
+  """Per-row temperature/top-k/top-p filtering with TRACED parameters —
+  the distribution half of :func:`sample_token_slots` (same filter
+  semantics and order as ``models.gpt.sample_logits``: top-k, then top-p
+  over the survivors), shared with speculative verification
+  (serving/speculative/verify.py), whose acceptance rule must judge
+  drafts against EXACTLY the distribution sampling would draw from.
 
-  ``logits`` [N, V]; ``keys`` uint32 [N, 2] per-slot PRNG keys;
-  ``temperature``/``top_p`` f32 [N]; ``top_k`` int32 [N] (0 disables).
-  Returns int32 [N] token ids.
+  ``logits`` [M, V]; ``temperature``/``top_p`` f32 [M]; ``top_k`` int32
+  [M] (0 disables).  Returns the scaled, filtered logits [M, V]
+  (filtered entries at -1e30); their softmax is the sampling
+  distribution at ``temperature > 0``.
   """
   V = logits.shape[-1]
-  greedy = jnp.argmax(logits, axis=-1)
   neg = jnp.asarray(-1e30, logits.dtype)
   t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
   scaled = logits / t.astype(logits.dtype)
@@ -78,7 +91,22 @@ def sample_token_slots(logits, keys, temperature, top_k, top_p):
                              jnp.asarray(jnp.inf, scaled.dtype)),
                    axis=-1, keepdims=True)
   p_on = top_p[:, None] < 1.0
-  scaled = jnp.where(p_on & (scaled < thresh), neg, scaled)
+  return jnp.where(p_on & (scaled < thresh), neg, scaled)
+
+
+def sample_token_slots(logits, keys, temperature, top_k, top_p):
+  """Per-slot sampling with TRACED parameters — the vectorized twin of
+  ``models.gpt.sample_logits``, for the serving step where every slot
+  carries its own sampling knobs and every value must be an array
+  (static per-request values would recompile the fused step per
+  parameter combination).  ``temperature<=0`` is greedy.
+
+  ``logits`` [N, V]; ``keys`` uint32 [N, 2] per-slot PRNG keys;
+  ``temperature``/``top_p`` f32 [N]; ``top_k`` int32 [N] (0 disables).
+  Returns int32 [N] token ids.
+  """
+  greedy = jnp.argmax(logits, axis=-1)
+  scaled = filtered_logits(logits, temperature, top_k, top_p)
   sampled = jax.vmap(jax.random.categorical)(keys, scaled)
   return jnp.where(temperature <= 0, greedy, sampled).astype(jnp.int32)
 
@@ -107,18 +135,13 @@ class ContinuousBatchingEngine:
                max_batch: Optional[int] = None,
                stop_token: Optional[int] = None,
                donate_cache: Optional[bool] = None,
+               drafter=None, speculative: Optional[bool] = None,
+               draft_model=None, draft_params=None,
                stats=None, metrics_writer=None,
                config=None):
     cfg = model.cfg
     conf = (config if config is not None else Env.get().config).serving
-    if cfg.pipeline_stages > 1:
-      raise ValueError(
-          "the serving engine is single-program (pipeline_stages=1); "
-          "restore the checkpoint into a non-pipelined config "
-          "(runtime.saver.restore_params) — see docs/serving.md")
-    if cfg.num_experts > 0:
-      raise ValueError("serving MoE checkpoints is not supported yet "
-                       "(ROADMAP open item)")
+    check_servable(cfg)
     self.model = model
     self.params = params
     self.mesh = mesh
@@ -134,12 +157,15 @@ class ContinuousBatchingEngine:
       raise ValueError(
           f"prefill_token_budget {budget} below prefill_chunk "
           f"{self.chunk}: no admission could ever afford its first chunk")
+    self.drafter = self._resolve_drafter(conf, drafter, speculative,
+                                         draft_model, draft_params)
     self.scheduler = FCFSScheduler(
         num_slots=self.num_slots, prefill_chunk=self.chunk,
         max_seq_len=cfg.max_seq_len, prefill_token_budget=budget,
         max_batch=max_batch if max_batch is not None else conf.max_batch,
         stop_token=stop_token if stop_token is not None
-        else conf.stop_token)
+        else conf.stop_token,
+        spec_k=self.drafter.k if self.drafter is not None else 0)
     self.stats = stats
     self.metrics_writer = metrics_writer
     if stats is not None:
@@ -151,26 +177,77 @@ class ContinuousBatchingEngine:
         cfg, self.num_slots, self.chunk, mesh)
     self._steps = 0
     donate = conf.donate_cache if donate_cache is None else donate_cache
-    self._step_fn = self._build_step(donate)
+    if self.drafter is not None:
+      self.drafter.bind(self)
+      self._step_fn = self._build_spec_step(donate)
+    else:
+      self._step_fn = self._build_step(donate)
     get_logger().info(
         "serving engine: %d slots x chunk %d (cache %.1f MB, %s), "
-        "prefill budget %s, max batch %d", self.num_slots, self.chunk,
+        "prefill budget %s, max batch %d, speculation %s",
+        self.num_slots, self.chunk,
         kv_lib.cache_bytes(cfg, self.num_slots, self.chunk) / 1e6,
         "mesh-sharded" if mesh is not None else "single-program",
-        budget or "uncapped", self.scheduler.max_batch)
+        budget or "uncapped", self.scheduler.max_batch,
+        f"{type(self.drafter).__name__}(k={self.drafter.k})"
+        if self.drafter is not None else "off")
+
+  def _resolve_drafter(self, conf, drafter, speculative, draft_model,
+                       draft_params):
+    """``speculative=False`` wins over everything (an explicit opt-out
+    must be trustworthy even when a drafter object was constructed);
+    otherwise an explicit ``drafter`` wins, and ``serving.speculative.*``
+    decides the rest (``speculative=True`` overrides its ``enabled``).
+    Any resolved drafter must fit the fused step's chunk
+    (k + 1 <= prefill_chunk)."""
+    from easyparallellibrary_tpu.serving.speculative import (
+        DraftModelDrafter, NgramDrafter)
+    if speculative is False:
+      return None
+    spec = conf.speculative
+    if drafter is None and (spec.enabled or speculative):
+      if spec.kind == "ngram":
+        drafter = NgramDrafter(k=spec.k, ngram_max=spec.ngram_max,
+                               ngram_min=spec.ngram_min)
+      else:  # "draft_model" (config validation rejects anything else)
+        if draft_model is None or draft_params is None:
+          raise ValueError(
+              "serving.speculative.kind='draft_model' needs the drafter's "
+              "weights: pass draft_model=/draft_params= (e.g. via "
+              "DraftModelDrafter.from_checkpoint) or a drafter= instance")
+        drafter = DraftModelDrafter(draft_model, draft_params, k=spec.k)
+    if drafter is not None:
+      check_draft_fits_chunk(drafter.k, self.chunk)
+    return drafter
 
   # ----------------------------------------------------------- device step
 
+  def _jit_step(self, step, donate: bool, n_rep_in: int, n_rep_out: int):
+    """jit a fused step with the engine's donation/placement discipline:
+    cache + cursors donated (argnums 1, 2), everything after them
+    replicated when a mesh is attached."""
+    jit_kwargs: Dict[str, Any] = {}
+    if donate:
+      jit_kwargs["donate_argnums"] = (1, 2)   # cache + cursors
+    if self.mesh is not None:
+      from easyparallellibrary_tpu.parallel.api import state_shardings
+      kv_sh, cur_sh = kv_lib.kv_cache_shardings(self.model.cfg, self.mesh)
+      param_sh = state_shardings(self.params, self.mesh)
+      rep = cur_sh
+      jit_kwargs["in_shardings"] = (
+          (param_sh, kv_sh, cur_sh) + (rep,) * n_rep_in)
+      jit_kwargs["out_shardings"] = (rep,) * n_rep_out + (kv_sh, cur_sh)
+    return jax.jit(step, **jit_kwargs)
+
   def _build_step(self, donate: bool):
+    from easyparallellibrary_tpu.models.gpt import slot_step_logits
     model = self.model
     C = self.chunk
 
     def step(params, kv, cursors, tokens, num_valid, reset, keys,
              tok_index, temperature, top_k, top_p):
       cursors = jnp.where(reset, 0, cursors)
-      logits, mut = model.apply(
-          {"params": params, "cache": kv}, tokens, decode=True,
-          slot_cursors=cursors, mutable=["cache"])
+      logits, kv = slot_step_logits(model, params, kv, tokens, cursors)
       # Each slot's next-token logits sit at its LAST live chunk
       # position; idle slots (num_valid=0) read position 0 — garbage the
       # scheduler never consumes.
@@ -180,20 +257,50 @@ class ContinuousBatchingEngine:
       step_keys = jax.vmap(jax.random.fold_in)(keys, tok_index)
       nxt = sample_token_slots(last.astype(jnp.float32), step_keys,
                                temperature, top_k, top_p)
-      return nxt, mut["cache"], cursors + num_valid
+      return nxt, kv, cursors + num_valid
 
-    jit_kwargs: Dict[str, Any] = {}
-    if donate:
-      jit_kwargs["donate_argnums"] = (1, 2)   # cache + cursors
-    if self.mesh is not None:
-      from easyparallellibrary_tpu.parallel.api import state_shardings
-      kv_sh, cur_sh = kv_lib.kv_cache_shardings(model.cfg, self.mesh)
-      param_sh = state_shardings(self.params, self.mesh)
-      rep = cur_sh
-      jit_kwargs["in_shardings"] = (
-          param_sh, kv_sh, cur_sh, rep, rep, rep, rep, rep, rep, rep, rep)
-      jit_kwargs["out_shardings"] = (rep, kv_sh, cur_sh)
-    return jax.jit(step, **jit_kwargs)
+    return self._jit_step(step, donate, n_rep_in=8, n_rep_out=1)
+
+  def _build_spec_step(self, donate: bool):
+    """The speculative twin of :meth:`_build_step`: the SAME single
+    model call (drafts ride the chunk positions plain decode wastes, so
+    verification adds no model compute), followed by in-jit per-slot
+    accept/rollback (serving/speculative/verify.py).  Shapes are static
+    in ``k_max = drafter.k``; per-slot draft length is data
+    (``num_draft``), so joins/leaves/short proposals never recompile.
+    """
+    from easyparallellibrary_tpu.models.gpt import slot_step_logits
+    from easyparallellibrary_tpu.serving.speculative.verify import (
+        verify_tokens)
+    model = self.model
+    C = self.chunk
+    K = self.drafter.k
+
+    def step(params, kv, cursors, tokens, num_valid, num_draft, reset,
+             keys, tok_index, temperature, top_k, top_p):
+      cursors = jnp.where(reset, 0, cursors)
+      logits, kv = slot_step_logits(model, params, kv, tokens, cursors)
+      # base = non-draft tokens fed (prefill grant, or 1 for decode);
+      # position base-1+j's logits are the target distribution for
+      # draft j, and base-1+num_draft's feed the bonus token.  With
+      # num_draft=0 row 0 is exactly the legacy step's `last` gather.
+      base = num_valid - num_draft
+      pos = jnp.clip(base[:, None] - 1 + jnp.arange(K + 1)[None],
+                     0, C - 1)
+      tgt = jnp.take_along_axis(
+          logits, pos[:, :, None], axis=1).astype(jnp.float32)
+      dpos = jnp.clip(base[:, None] + jnp.arange(K)[None], 0, C - 1)
+      drafts = jnp.take_along_axis(tokens, dpos, axis=1)
+      committed, n_committed, accepted = verify_tokens(
+          tgt, drafts, num_draft, keys, tok_index, temperature, top_k,
+          top_p)
+      # Rollback is pure cursor math: the cache keeps K/V for the fed
+      # non-draft tokens plus the accepted prefix; rejected-draft K/V
+      # beyond the new cursor is masked and later overwritten, exactly
+      # like chunked-prefill garbage.
+      return committed, n_committed, kv, cursors + base + accepted
+
+    return self._jit_step(step, donate, n_rep_in=9, n_rep_out=2)
 
   # ------------------------------------------------------------ host loop
 
@@ -207,32 +314,60 @@ class ContinuousBatchingEngine:
     return self.scheduler.has_work
 
   def step(self) -> List[FinishedRequest]:
-    """One engine iteration: plan -> fused device step -> commit.
-    Returns the requests that retired this iteration (empty when idle)."""
+    """One engine iteration: plan -> [draft ->] fused device step ->
+    commit.  Returns the requests that retired this iteration (empty
+    when idle)."""
     plan = self.scheduler.plan_step()
     if plan is None:
       return []
     t0 = time.monotonic()
-    nxt, self._kv, self._cursors = self._step_fn(
-        self.params, self._kv, self._cursors, plan.tokens,
-        plan.num_valid, plan.reset, plan.keys, plan.tok_index,
-        plan.temperature, plan.top_k, plan.top_p)
-    finished = self.scheduler.commit(np.asarray(nxt))
+    drafted = accepted = 0
+    if self.drafter is not None:
+      # Propose BEFORE the token block gains drafts: the draft model's
+      # mirror call needs the same plan the target sees.
+      histories = self.scheduler.slot_histories(plan)
+      draft_tokens, num_draft = self.drafter.propose(plan, histories)
+      num_draft = np.minimum(
+          np.asarray(num_draft, np.int32), plan.draft_cap)
+      for slot in np.nonzero(num_draft)[0]:
+        nd = int(num_draft[slot])
+        plan.tokens[slot, 1:1 + nd] = draft_tokens[slot, :nd]
+      committed, n_committed, self._kv, self._cursors = self._step_fn(
+          self.params, self._kv, self._cursors, plan.tokens,
+          plan.num_valid + num_draft, num_draft, plan.reset, plan.keys,
+          plan.tok_index, plan.temperature, plan.top_k, plan.top_p)
+      n_committed = np.asarray(n_committed)
+      finished = self.scheduler.commit(np.asarray(committed), n_committed)
+      self.drafter.observe_commit(self._cursors)
+      speculated = num_draft > 0
+      drafted = int(num_draft.sum())
+      accepted = int((n_committed[speculated] - 1).sum())
+    else:
+      nxt, self._kv, self._cursors = self._step_fn(
+          self.params, self._kv, self._cursors, plan.tokens,
+          plan.num_valid, plan.reset, plan.keys, plan.tok_index,
+          plan.temperature, plan.top_k, plan.top_p)
+      finished = self.scheduler.commit(np.asarray(nxt))
     self._steps += 1
     dt = time.monotonic() - t0
     if self.stats is not None:
       self.stats.note_step(
           active_slots=plan.active_slots, num_slots=self.num_slots,
           prefill_tokens=plan.prefill_tokens,
-          decode_tokens=plan.decode_tokens, step_time_s=dt)
+          decode_tokens=plan.decode_tokens, step_time_s=dt,
+          drafted_tokens=drafted, accepted_tokens=accepted)
     if self.metrics_writer is not None:
-      self.metrics_writer.write(self._steps, {
+      record = {
           "active_slots": plan.active_slots,
           "slot_occupancy": plan.active_slots / self.num_slots,
           "prefill_tokens": plan.prefill_tokens,
           "decode_tokens": plan.decode_tokens,
           "step_time_s": dt,
-      })
+      }
+      if self.drafter is not None:
+        record["drafted_tokens"] = drafted
+        record["accepted_tokens"] = accepted
+      self.metrics_writer.write(self._steps, record)
     return finished
 
   def run(self, max_steps: Optional[int] = None

@@ -46,6 +46,10 @@ class Request:
   stop-token retirement; when hit, the stop token IS included in the
   output (the caller sees why the request ended).  ``seed`` starts the
   request's private RNG stream (defaults to a hash of ``uid``).
+  ``speculative`` toggles speculative decoding per request: None
+  follows the engine (a drafter is configured or not), False opts this
+  request out (it then keeps the engine's non-speculative sample stream
+  bit-exactly), True is a no-op on an engine without a drafter.
   """
   uid: Any
   prompt: np.ndarray
@@ -55,6 +59,7 @@ class Request:
   top_p: float = 1.0
   stop_token: int = -1
   seed: Optional[int] = None
+  speculative: Optional[bool] = None
 
 
 @dataclasses.dataclass
@@ -76,6 +81,7 @@ class StepPlan:
   temperature: np.ndarray     # f32   [N]
   top_k: np.ndarray           # int32 [N]
   top_p: np.ndarray           # f32   [N]
+  draft_cap: np.ndarray       # int32 [N] max speculative drafts this step
   prefill_tokens: int         # scheduled prompt tokens this step
   decode_tokens: int          # scheduled decode tokens this step
   active_slots: int
@@ -119,15 +125,21 @@ class FCFSScheduler:
 
   def __init__(self, num_slots: int, prefill_chunk: int,
                max_seq_len: int, prefill_token_budget: int = 0,
-               max_batch: int = 0, stop_token: int = -1):
+               max_batch: int = 0, stop_token: int = -1,
+               spec_k: int = 0):
     from easyparallellibrary_tpu.serving.kv_cache import SlotAllocator
     if prefill_chunk < 1:
       raise ValueError(f"prefill_chunk must be >= 1: {prefill_chunk}")
     if prefill_token_budget < 0 or max_batch < 0:
       raise ValueError("prefill_token_budget and max_batch must be >= 0")
+    if spec_k < 0:
+      raise ValueError(f"spec_k must be >= 0: {spec_k}")
     self.num_slots = num_slots
     self.chunk = prefill_chunk
     self.max_seq_len = max_seq_len
+    # Max speculative drafts per decode slot per step (0 = engine has no
+    # drafter); per-request Request.speculative=False opts out.
+    self.spec_k = spec_k
     # 0 = uncapped: every prefilling slot gets a full chunk each step.
     self.prefill_token_budget = prefill_token_budget
     self.max_batch = max_batch if max_batch > 0 else num_slots
@@ -223,6 +235,7 @@ class FCFSScheduler:
         temperature=np.zeros((N,), np.float32),
         top_k=np.zeros((N,), np.int32),
         top_p=np.ones((N,), np.float32),
+        draft_cap=np.zeros((N,), np.int32),
         prefill_tokens=0, decode_tokens=0,
         active_slots=len(self.active))
     budget = self.prefill_token_budget
@@ -252,8 +265,25 @@ class FCFSScheduler:
         plan.tokens[slot, 0] = state.generated[-1]
         plan.num_valid[slot] = 1
         plan.decode_tokens += 1
+        if self.spec_k > 0 and req.speculative is not False:
+          # Drafting past the request's remaining budget is pure waste:
+          # at most (remaining - 1) drafts can commit alongside the
+          # step's guaranteed token.
+          remaining = req.max_new_tokens - len(state.generated)
+          plan.draft_cap[slot] = max(0, min(self.spec_k, remaining - 1))
     self._plan = plan
     return plan
+
+  def slot_histories(self, plan: StepPlan) -> Dict[int, np.ndarray]:
+    """Committed tokens (prompt + generated) per draft-eligible slot of
+    ``plan`` — the context drafters propose from."""
+    out: Dict[int, np.ndarray] = {}
+    for slot, state in self.active.items():
+      if plan.draft_cap[slot] > 0:
+        out[slot] = np.concatenate(
+            [state.req.prompt,
+             np.asarray(state.generated, np.int32)])
+    return out
 
   # --------------------------------------------------------------- commit
 
@@ -273,14 +303,27 @@ class FCFSScheduler:
       self.on_finish(fin)
     return fin
 
-  def commit(self, next_tokens: np.ndarray) -> List[FinishedRequest]:
-    """Fold one step's sampled tokens ``[N]`` back into request state;
-    returns retirements.  A slot's sampled token only counts when its
-    prompt is fully consumed — mid-prefill samples are positions whose
-    "next token" is still dictated by the prompt."""
+  def commit(self, next_tokens: np.ndarray,
+             num_committed: Optional[np.ndarray] = None
+             ) -> List[FinishedRequest]:
+    """Fold one step's committed tokens back into request state; returns
+    retirements.  ``next_tokens`` is ``[N]`` (one sampled token per
+    slot, the non-speculative step) or ``[N, K+1]`` with
+    ``num_committed [N]`` (speculative verification: accepted drafts
+    plus the correction/bonus token).  A slot's tokens only count when
+    its prompt is fully consumed — mid-prefill samples are positions
+    whose "next token" is still dictated by the prompt.  Multi-token
+    commits apply stop-token and ``max_new_tokens`` checks PER TOKEN in
+    commit order, so a stop token appearing mid-draft retires the
+    request and discards the rest of its accepted drafts."""
     if self._plan is None:
       raise RuntimeError("commit() without a preceding plan_step()")
     plan, self._plan = self._plan, None
+    tokens = np.asarray(next_tokens)
+    if tokens.ndim == 1:
+      tokens = tokens[:, None]
+    if num_committed is None:
+      num_committed = np.ones((tokens.shape[0],), np.int32)
     finished: List[FinishedRequest] = []
     now = time.monotonic()
     for slot in list(self._admit_order):
@@ -288,18 +331,20 @@ class FCFSScheduler:
       if state is None or plan.num_valid[slot] == 0:
         continue
       req = state.req
-      was_prefilling = state.prefilling
-      if was_prefilling:
+      if state.prefilling:
         state.prompt_pos += int(plan.num_valid[slot])
         if state.prefilling:
           continue  # more prompt to feed; discard the sample
         state.first_token_at = now
         if self.on_first_token:
           self.on_first_token(req.uid)
-      tok = int(next_tokens[slot])
-      state.generated.append(tok)
-      if req.stop_token >= 0 and tok == req.stop_token:
-        finished.append(self._retire(state, "stop_token"))
-      elif len(state.generated) >= req.max_new_tokens:
-        finished.append(self._retire(state, "length"))
+      for j in range(int(num_committed[slot])):
+        tok = int(tokens[slot, j])
+        state.generated.append(tok)
+        if req.stop_token >= 0 and tok == req.stop_token:
+          finished.append(self._retire(state, "stop_token"))
+          break
+        if len(state.generated) >= req.max_new_tokens:
+          finished.append(self._retire(state, "length"))
+          break
     return finished

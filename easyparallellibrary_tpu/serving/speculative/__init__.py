@@ -1,0 +1,23 @@
+"""Speculative decoding — the serving engine's fourth component.
+
+Drafters guess the next ``k`` tokens of each decode slot (drafter.py),
+the fused step scores all of them in its one model call (they ride the
+``[num_slots, chunk]`` positions plain decode wastes — verification is
+nearly free), and the verifier keeps each slot's accepted prefix plus
+one correction/bonus token with on-device cursor rollback (verify.py).
+Greedy output stays bit-exact; sampled output keeps its distribution
+(Leviathan et al. rejection sampling).  See docs/serving.md
+"Speculative decoding".
+"""
+
+from easyparallellibrary_tpu.serving.speculative.drafter import (
+    Drafter, DraftModelDrafter, NgramDrafter, ngram_propose,
+)
+from easyparallellibrary_tpu.serving.speculative.verify import (
+    verify_tokens,
+)
+
+__all__ = [
+    "Drafter", "DraftModelDrafter", "NgramDrafter", "ngram_propose",
+    "verify_tokens",
+]

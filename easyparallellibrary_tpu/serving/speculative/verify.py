@@ -1,0 +1,134 @@
+"""Batched speculative verification: per-slot accept/rollback, in-jit.
+
+One call decides, for every slot at once, how many of its ``k`` drafted
+tokens the target model keeps and what the one guaranteed extra token is
+— greedy exact-match acceptance or Leviathan et al.'s rejection-sampling
+acceptance, both with static shapes so the fused serving step never
+recompiles as draft lengths vary.
+
+Contract (drafters here are deterministic — prompt-lookup or greedy
+draft-model — i.e. a point-mass proposal ``q = onehot(d)``):
+
+* **greedy** (``temperature <= 0``): draft ``d_j`` is accepted while it
+  equals ``argmax`` of the target logits at its position; the token at
+  the first mismatch is the argmax itself (the correction), and when all
+  drafts survive the bonus token is the argmax after them.  Committed
+  ids are therefore bit-identical to non-speculative greedy decode.
+* **sampled**: draft ``d_j`` is accepted with probability
+  ``min(1, p_j(d_j) / q_j(d_j)) = p_j(d_j)`` where ``p_j`` is the
+  target distribution AFTER the request's temperature/top-k/top-p
+  filters (``engine.filtered_logits`` — the same distribution
+  ``sample_token_slots`` draws from).  On rejection the committed token
+  samples the residual ``norm(max(p_j - q_j, 0))`` — ``p_j`` with the
+  rejected id removed; with all drafts accepted the bonus samples
+  ``p_k``.  Per position the emitted token is distributed exactly as
+  ``p_j`` (accept: ``p(d)``; reject then residual:
+  ``(1 - p(d)) * p(y) / (1 - p(d))``), so speculation preserves the
+  sampling distribution while changing the bitstream.
+
+Randomness rides the per-request PRNG streams folded by **committed
+token index** (scheduler contract): the decision for committed index
+``t`` derives from ``fold_in(request_key, t)`` — independent of slot,
+iteration, or how many drafts rode along.  A slot with ``num_draft == 0``
+uses the plain committed-index fold for its sample, so requests served
+without drafts (speculation off per-request, or an empty proposal)
+reproduce the non-speculative engine's sample stream bit-exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from easyparallellibrary_tpu.serving.engine import filtered_logits
+
+# Salts separating the acceptance-uniform and residual/bonus-sample
+# streams derived from one committed-index fold.  The PLAIN fold (no
+# salt) is reserved for the num_draft == 0 sample so that path stays
+# bit-identical to the non-speculative engine.
+_ACCEPT_SALT = 0x5bec
+_SAMPLE_SALT = 0xd4a7
+
+
+def verify_tokens(target_logits, draft_tokens, num_draft, keys, tok_index,
+                  temperature, top_k, top_p):
+  """Accept/rollback for one fused step, vectorized over slots.
+
+  ``target_logits`` f32 ``[N, K+1, V]`` — row ``j`` is the target
+  distribution (pre-filter logits) for the token FOLLOWING draft ``j``'s
+  predecessor, i.e. the distribution draft ``j`` is judged against;
+  row ``K`` (== row ``num_draft``) feeds the bonus token.
+  ``draft_tokens`` int32 ``[N, K]``; ``num_draft`` int32 ``[N]`` in
+  ``[0, K]`` (rows beyond a slot's count are ignored).  ``keys`` uint32
+  ``[N, 2]`` per-request PRNG keys, ``tok_index`` int32 ``[N]`` tokens
+  committed so far; ``temperature``/``top_k``/``top_p`` per-slot
+  sampling knobs with ``sample_token_slots`` semantics.
+
+  Returns ``(committed [N, K+1] int32, n_committed [N] int32,
+  accepted [N] int32)`` with ``n_committed = accepted + 1``: the
+  accepted draft prefix plus one correction/bonus token.  Only the first
+  ``n_committed`` entries of each row are meaningful.
+  """
+  N, K1, V = target_logits.shape
+  K = K1 - 1
+  rep = lambda a: jnp.repeat(a, K1, axis=0)
+  filt = filtered_logits(
+      target_logits.reshape(N * K1, V), rep(temperature), rep(top_k),
+      rep(top_p)).reshape(N, K1, V)
+  probs = jax.nn.softmax(filt, axis=-1)
+  greedy_tok = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)
+
+  # One fold per committed token index this step could produce.
+  idx = tok_index[:, None] + jnp.arange(K1)[None]          # [N, K+1]
+  fold_grid = jax.vmap(jax.vmap(jax.random.fold_in, in_axes=(None, 0)),
+                       in_axes=(0, 0))
+  folded = fold_grid(keys, idx)                            # [N, K+1, 2]
+  accept_u = jax.vmap(jax.vmap(
+      lambda k_: jax.random.uniform(
+          jax.random.fold_in(k_, _ACCEPT_SALT))))(folded)  # [N, K+1]
+
+  p_draft = jnp.take_along_axis(
+      probs[:, :K], draft_tokens[:, :, None], axis=-1)[..., 0]
+  greedy_mode = temperature <= 0
+  ok = jnp.where(greedy_mode[:, None],
+                 draft_tokens == greedy_tok[:, :K],
+                 accept_u[:, :K] < p_draft)
+  ok = ok & (jnp.arange(K)[None] < num_draft[:, None])
+  # Longest accepted PREFIX: a rejection voids everything after it (the
+  # later drafts were conditioned on the rejected token).
+  prefix = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+  accepted = jnp.sum(prefix, axis=1).astype(jnp.int32)
+
+  # The guaranteed extra token at draft index a = accepted: bonus from
+  # p_a when every draft survived, else the residual at the rejection.
+  a = accepted
+  fin_filt = jnp.take_along_axis(filt, a[:, None, None], axis=1)[:, 0]
+  fin_greedy = jnp.take_along_axis(greedy_tok, a[:, None], axis=1)[:, 0]
+  rej_tok = jnp.take_along_axis(
+      draft_tokens, jnp.clip(a, 0, K - 1)[:, None], axis=1)[:, 0]
+  is_bonus = a == num_draft
+  resid = jnp.where(jax.nn.one_hot(rej_tok, V, dtype=bool),
+                    -jnp.inf, fin_filt)
+  # Degenerate residual (the filtered support was exactly the rejected
+  # token — reachable only through float roundoff on an accept
+  # probability of 1): fall back to the filtered distribution rather
+  # than sampling uniformly over filtered-out ids.
+  resid_ok = jnp.any(resid > jnp.asarray(-1e29, resid.dtype), axis=-1,
+                     keepdims=True)
+  resid = jnp.where(resid_ok, resid, fin_filt)
+  fin_logits = jnp.where(is_bonus[:, None], fin_filt, resid)
+
+  fold_a = jnp.take_along_axis(folded, a[:, None, None], axis=1)[:, 0]
+  salted = jax.vmap(
+      lambda k_: jax.random.fold_in(k_, _SAMPLE_SALT))(fold_a)
+  samp_keys = jnp.where((num_draft == 0)[:, None], fold_a, salted)
+  sampled = jax.vmap(jax.random.categorical)(samp_keys, fin_logits)
+  fin = jnp.where(greedy_mode, fin_greedy,
+                  sampled.astype(jnp.int32)).astype(jnp.int32)
+
+  pad_drafts = jnp.concatenate(
+      [draft_tokens.astype(jnp.int32), jnp.zeros((N, 1), jnp.int32)],
+      axis=1)
+  committed = jnp.where(jnp.arange(K1)[None] < a[:, None],
+                        pad_drafts, fin[:, None])
+  return committed, accepted + 1, accepted
