@@ -25,7 +25,12 @@ serve-bench:
 spec-bench:
 	python benchmarks/speculative_decode.py
 
+# Tiny traced fit() + serving episode on the CPU mesh -> trace_demo.json
+# (schema-validated; load at ui.perfetto.dev; docs/observability.md).
+trace-demo:
+	python benchmarks/trace_demo.py
+
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test bench chaos serve-bench spec-bench clean
+.PHONY: all build test bench chaos serve-bench spec-bench trace-demo clean

@@ -1,0 +1,139 @@
+"""Emit a demo trace: a tiny ``fit()`` plus a serving episode, traced.
+
+``make trace-demo`` runs this on the CPU mesh: a few training steps
+(with a mid-run checkpoint, so the stage/commit spans appear), then a
+speculative continuous-batching episode with staggered admissions (so
+per-request lifecycle tracks with prefill / speculate spans appear),
+all recorded by ONE ambient tracer into one timeline.  The script
+
+  * exports the Chrome-trace / Perfetto JSON (``trace_demo.json`` by
+    default — load it at ``ui.perfetto.dev``),
+  * schema-validates it (``observability.trace.validate_trace`` — the
+    same validator the quick test runs), and
+  * prints the latency-breakdown report
+    (``python -m easyparallellibrary_tpu.observability.report``).
+
+``run_demo()`` is importable: tests/test_observability.py drives it for
+the schema-validation quick test, so the artifact CI checks is the one
+this target emits.
+
+Run: ``python benchmarks/trace_demo.py [out.json]`` (or
+``make trace-demo``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+  os.environ["XLA_FLAGS"] = (
+      _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+  jax.config.update("jax_platforms", "cpu")
+
+
+def run_demo(out_path: str, workdir: str = "") -> str:
+  """Tiny traced fit() + serving episode; exports and returns the trace
+  path.  ``workdir`` holds the checkpoint dir (a temp dir when empty).
+  """
+  import jax.numpy as jnp
+  import numpy as np
+  import optax
+  from flax import linen as nn
+
+  import easyparallellibrary_tpu as epl
+  from easyparallellibrary_tpu import ops
+  from easyparallellibrary_tpu.models import GPT, GPTConfig
+  from easyparallellibrary_tpu.observability import trace as trace_lib
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state, make_train_step,
+      parallelize)
+  from easyparallellibrary_tpu.profiler import ServingStats
+  from easyparallellibrary_tpu.runtime.loop import fit
+  from easyparallellibrary_tpu.serving import (
+      ContinuousBatchingEngine, NgramDrafter, Request)
+
+  workdir = workdir or tempfile.mkdtemp(prefix="epl_trace_demo_")
+  epl.init(epl.Config({"observability": {
+      "enabled": True, "trace_path": out_path}}))
+  tracer = trace_lib.ensure_configured()
+
+  # --- tiny fit(): data-next / dispatch / checkpoint spans -------------
+  class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+      return ops.Dense(1, parallel="none")(jnp.tanh(
+          ops.Dense(8, parallel="none")(x)))
+
+  mesh = epl.current_plan().build_mesh()
+  model = Net()
+  r = np.random.RandomState(0)
+  batch = {"x": jnp.asarray(r.randn(16, 4), jnp.float32),
+           "y": jnp.asarray(r.randn(16, 1), jnp.float32)}
+
+  def init_fn(rng):
+    return TrainState.create(apply_fn=model.apply,
+                             params=model.init(rng, batch["x"])["params"],
+                             tx=optax.adam(1e-2))
+
+  state, shardings = create_sharded_train_state(
+      init_fn, mesh, jax.random.PRNGKey(0))
+
+  def loss_fn(params, b, rng):
+    pred = model.apply({"params": params}, b["x"])
+    return jnp.mean((pred - b["y"]) ** 2), {}
+
+  step = parallelize(make_train_step(loss_fn), mesh, shardings)
+  fit(step, state, [batch], num_steps=6,
+      checkpoint_dir=os.path.join(workdir, "ck"), checkpoint_every=3,
+      log_every=2, shardings=shardings)
+
+  # --- serving episode: staggered admissions, n-gram speculation -------
+  cfg = GPTConfig(vocab_size=64, num_layers=1, num_heads=4, d_model=32,
+                  d_ff=64, max_seq_len=48, dtype=jnp.float32)
+  gpt = GPT(cfg)
+  params = gpt.init(jax.random.PRNGKey(0),
+                    jnp.zeros((1, 4), jnp.int32))["params"]
+  eng = ContinuousBatchingEngine(
+      gpt, params, num_slots=2, prefill_chunk=4,
+      drafter=NgramDrafter(k=3, ngram_max=3), stats=ServingStats())
+  # Repetitive prompts so the n-gram drafter actually proposes.
+  prompts = [np.tile(np.arange(3, dtype=np.int32) + 7 * i, 3)
+             for i in range(4)]
+  for i in range(2):
+    eng.submit(Request(uid=f"req{i}", prompt=prompts[i],
+                       max_new_tokens=8))
+  for _ in range(2):  # the second wave joins a mid-flight batch
+    eng.step()
+  for i in range(2, 4):
+    eng.submit(Request(uid=f"req{i}", prompt=prompts[i],
+                       max_new_tokens=6))
+  eng.run()
+
+  return tracer.export(out_path)
+
+
+def main(argv=None) -> int:
+  from easyparallellibrary_tpu.observability import report
+  from easyparallellibrary_tpu.observability.trace import validate_trace
+  argv = sys.argv[1:] if argv is None else argv
+  out = argv[0] if argv else "trace_demo.json"
+  path = run_demo(out)
+  events = validate_trace(path)
+  print(f"trace OK: {len(events)} events -> {path} "
+        f"(load at ui.perfetto.dev)\n")
+  print(report.format_report(report.load_events(path)))
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

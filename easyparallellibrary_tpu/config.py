@@ -433,6 +433,41 @@ class ServingConfig(_Category):
     return _SubGroup(self, "speculative")
 
 
+class ObservabilityConfig(_Category):
+  """Unified tracing & telemetry (observability/, docs/observability.md).
+  New vs the reference, whose observability is re-pointed TF summaries
+  plus RunMetadata FULL_TRACE capture (epl/parallel/hooks.py:593-664)."""
+  _name = "observability"
+  _fields = {
+      # Master switch for the host-side span tracer: fit() and the
+      # serving engine record phase spans / per-request timelines into
+      # the ambient tracer (observability.trace.get_tracer()).  Off by
+      # default; when off every instrumentation site is a no-op context
+      # manager (no allocation, no host sync).
+      "enabled": False,
+      # Where fit() exports the Chrome-trace / Perfetto JSON at the end
+      # of a run ("" = <checkpoint_dir>/trace.json when a checkpoint dir
+      # is set, else no auto-export).  Serving callers export explicitly
+      # via get_tracer().export(path).  Load at ui.perfetto.dev.
+      "trace_path": "",
+      # Ring-buffer capacity in EVENTS (a span is two events).  The ring
+      # keeps the most recent window and counts what it evicted — a
+      # bounded-memory flight recorder, not a full-run archive.
+      "ring_capacity": 65536,
+      # Sampling for the per-step train-loop phase spans (data-next /
+      # step-dispatch / metrics-flush): record every 1/sample_rate-th
+      # step's phases.  Request-lifecycle, checkpoint, and resilience
+      # events are never sampled.  1.0 records everything.
+      "sample_rate": 1.0,
+      # When fit() gets a checkpoint_dir but no metrics_writer,
+      # auto-construct a leader-only JSONL MetricsWriter at
+      # <checkpoint_dir>/metrics.jsonl behind a namespaced
+      # MetricRegistry (train/* + resilience/* keys), so runs are never
+      # silently unlogged.  An explicitly passed writer always wins.
+      "metrics_jsonl": True,
+  }
+
+
 class Config:
   """Root configuration (reference: epl/config.py:181).
 
@@ -447,7 +482,7 @@ class Config:
       AutoParallelConfig, IOConfig, CommunicationConfig, PipelineConfig,
       GradientCheckpointConfig, ZeroConfig, OffloadConfig, AMPConfig,
       ClusterConfig, OptimizerConfig, SequenceConfig, ResilienceConfig,
-      ServingConfig,
+      ServingConfig, ObservabilityConfig,
   )
 
   def __init__(self, param_dict: Dict[str, Any] | None = None):
@@ -586,6 +621,12 @@ class Config:
       raise ValueError(
           "serving.speculative needs 1 <= ngram_min <= ngram_max; got "
           f"ngram_min={spec.ngram_min}, ngram_max={spec.ngram_max}")
+    if self.observability.ring_capacity < 1:
+      raise ValueError(f"observability.ring_capacity must be >= 1; "
+                       f"got {self.observability.ring_capacity}")
+    if not 0.0 < self.observability.sample_rate <= 1.0:
+      raise ValueError(f"observability.sample_rate must be in (0, 1]; "
+                       f"got {self.observability.sample_rate}")
     if spec.enabled and spec.k + 1 > self.serving.prefill_chunk:
       raise ValueError(
           f"serving.speculative.k={spec.k} needs serving.prefill_chunk "

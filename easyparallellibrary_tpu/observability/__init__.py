@@ -1,0 +1,31 @@
+"""Unified tracing & telemetry (docs/observability.md).
+
+One event substrate for the whole runtime:
+
+* :mod:`trace` — ring-buffered host-side span tracer with Chrome /
+  Perfetto JSON export, ``jax.profiler`` capture attachment, and a
+  cheap ambient ``get_tracer()`` the training loop, checkpoint path,
+  resilience layer, and serving stack all record into;
+* :mod:`registry` — the namespaced metric schema (``train/*``,
+  ``serving/*``, ``comm/*``, ``resilience/*``) feeding the existing
+  ``MetricsWriter`` / ``TensorBoardWriter`` sinks;
+* :mod:`report` — ``python -m easyparallellibrary_tpu.observability
+  .report <trace>`` latency-breakdown summaries, including per-request
+  serving timelines.
+
+Knobs: the ``observability.*`` config group (enabled / trace_path /
+ring_capacity / sample_rate / metrics_jsonl).
+"""
+
+from easyparallellibrary_tpu.observability.registry import (
+    NAMESPACES, MetricRegistry, split_namespaces,
+)
+from easyparallellibrary_tpu.observability.trace import (
+    Tracer, ensure_configured, get_tracer, install, validate_trace,
+)
+
+__all__ = [
+    "MetricRegistry", "NAMESPACES", "split_namespaces",
+    "Tracer", "ensure_configured", "get_tracer", "install",
+    "validate_trace",
+]

@@ -1,0 +1,191 @@
+"""Latency-breakdown summary over an exported trace.
+
+``python -m easyparallellibrary_tpu.observability.report <trace.json>``
+prints, without leaving the terminal for Perfetto:
+
+* a **span table** — per span name: count, total/mean/p50/p99 duration
+  and share of the trace's wall clock (where did the run's time go);
+* **request timelines** — per serving request: queue wait, prefill
+  time/chunks, decode steps, speculation drafted/accepted, TTFT,
+  total latency and finish reason (where did THIS request's latency
+  go).
+
+Reads the Chrome-trace JSON the tracer exports (observability/trace.py)
+— and nothing else; the report is a pure function of the artifact, so
+it works on traces mailed in from another machine.  Unmatched B/E
+events (a ring buffer that wrapped mid-span) are skipped and counted
+rather than fatal — post-mortems read partial traces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from easyparallellibrary_tpu.profiler.serving import percentile
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+  with open(path) as f:
+    doc = json.load(f)
+  return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def pair_spans(events: List[Dict[str, Any]]
+               ) -> Tuple[List[Dict[str, Any]], int]:
+  """Match B/E pairs per (pid, tid) into completed spans
+  ``{name, cat, ts, dur, tid, args}``; returns (spans, unmatched)."""
+  spans: List[Dict[str, Any]] = []
+  unmatched = 0
+  stacks: Dict[Tuple[Any, Any], List[Dict[str, Any]]] = {}
+  for ev in sorted((e for e in events if e.get("ph") in ("B", "E")),
+                   key=lambda e: e.get("ts", 0.0)):
+    key = (ev.get("pid"), ev.get("tid"))
+    stack = stacks.setdefault(key, [])
+    if ev["ph"] == "B":
+      stack.append(ev)
+      continue
+    if not stack or stack[-1]["name"] != ev.get("name", stack[-1]["name"]):
+      unmatched += 1
+      continue
+    b = stack.pop()
+    args = dict(b.get("args") or {})
+    args.update(ev.get("args") or {})
+    spans.append({"name": b["name"], "cat": b.get("cat", ""),
+                  "ts": b["ts"], "dur": ev["ts"] - b["ts"],
+                  "tid": key[1], "args": args})
+  unmatched += sum(len(s) for s in stacks.values())
+  return spans, unmatched
+
+
+def span_table(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+  """Aggregate spans by name into count/total/mean/p50/p99 rows,
+  sorted by total time descending."""
+  by_name: Dict[str, List[float]] = {}
+  for sp in spans:
+    by_name.setdefault(sp["name"], []).append(sp["dur"])
+  rows = []
+  for name, durs in by_name.items():
+    rows.append({
+        "name": name, "count": len(durs), "total_us": sum(durs),
+        "mean_us": sum(durs) / len(durs),
+        "p50_us": percentile(durs, 50), "p99_us": percentile(durs, 99)})
+  rows.sort(key=lambda r: -r["total_us"])
+  return rows
+
+
+def request_timelines(events: List[Dict[str, Any]]
+                      ) -> List[Dict[str, Any]]:
+  """Per-request lifecycle rollup from the serving instrumentation:
+  request spans (cat ``serving.request``), the prefill/decode/speculate
+  chunk spans nested in them, and the submit/first_token instants."""
+  spans, _ = pair_spans(events)
+  submits: Dict[str, float] = {}
+  first_tokens: Dict[str, float] = {}
+  for ev in events:
+    if ev.get("ph") != "i":
+      continue
+    uid = (ev.get("args") or {}).get("uid")
+    if uid is None:
+      continue
+    if ev.get("name") == "serving/submit":
+      submits[str(uid)] = ev["ts"]
+    elif ev.get("name") == "serving/first_token":
+      first_tokens[str(uid)] = ev["ts"]
+  requests = []
+  for req in (s for s in spans if s["cat"] == "serving.request"):
+    uid = str(req["args"].get("uid", req["name"]))
+    t0, t1 = req["ts"], req["ts"] + req["dur"]
+    inner = [s for s in spans
+             if s["tid"] == req["tid"] and s["name"] != req["name"]
+             and t0 <= s["ts"] and s["ts"] + s["dur"] <= t1 + 1e-9]
+    phase_us = {ph: sum(s["dur"] for s in inner if s["name"] == ph)
+                for ph in ("prefill", "decode", "speculate")}
+    drafted = sum(s["args"].get("drafted", 0) for s in inner
+                  if s["name"] == "speculate")
+    accepted = sum(s["args"].get("accepted", 0) for s in inner
+                   if s["name"] == "speculate")
+    submit = submits.get(uid)
+    ttft = first_tokens.get(uid)
+    requests.append({
+        "uid": uid,
+        "queue_wait_us": (t0 - submit) if submit is not None else None,
+        "admitted_ts_us": t0,
+        "total_us": req["dur"],
+        "ttft_us": (ttft - (submit if submit is not None else t0))
+                   if ttft is not None else None,
+        "prefill_us": phase_us["prefill"],
+        "prefill_chunks": sum(1 for s in inner if s["name"] == "prefill"),
+        "decode_steps": sum(1 for s in inner
+                            if s["name"] in ("decode", "speculate")),
+        "decode_us": phase_us["decode"] + phase_us["speculate"],
+        "drafted": drafted, "accepted": accepted,
+        "new_tokens": req["args"].get("new_tokens"),
+        "finish_reason": req["args"].get("finish_reason"),
+    })
+  requests.sort(key=lambda r: r["admitted_ts_us"])
+  return requests
+
+
+def _fmt_us(us: Optional[float]) -> str:
+  if us is None:
+    return "-"
+  return f"{us / 1e3:.2f}ms" if us >= 1e3 else f"{us:.0f}us"
+
+
+def format_report(events: List[Dict[str, Any]]) -> str:
+  spans, unmatched = pair_spans(events)
+  lines: List[str] = []
+  wall = 0.0
+  if spans:
+    wall = max(s["ts"] + s["dur"] for s in spans) - \
+        min(s["ts"] for s in spans)
+  lines.append(f"{len(events)} events, {len(spans)} spans over "
+               f"{_fmt_us(wall)} wall clock"
+               + (f" ({unmatched} unmatched B/E skipped)"
+                  if unmatched else ""))
+  lines.append("")
+  lines.append(f"{'span':<28}{'count':>7}{'total':>11}{'mean':>10}"
+               f"{'p50':>10}{'p99':>10}{'share':>8}")
+  for row in span_table(spans):
+    share = row["total_us"] / wall if wall else 0.0
+    lines.append(
+        f"{row['name']:<28}{row['count']:>7}"
+        f"{_fmt_us(row['total_us']):>11}{_fmt_us(row['mean_us']):>10}"
+        f"{_fmt_us(row['p50_us']):>10}{_fmt_us(row['p99_us']):>10}"
+        f"{share:>7.1%}")
+  requests = request_timelines(events)
+  if requests:
+    lines.append("")
+    lines.append(f"{'request':<12}{'wait':>9}{'ttft':>10}{'prefill':>10}"
+                 f"{'chunks':>7}{'decode':>10}{'steps':>6}{'drafted':>8}"
+                 f"{'accepted':>9}{'total':>10}  finish")
+    for r in requests:
+      lines.append(
+          f"{r['uid']:<12}{_fmt_us(r['queue_wait_us']):>9}"
+          f"{_fmt_us(r['ttft_us']):>10}{_fmt_us(r['prefill_us']):>10}"
+          f"{r['prefill_chunks']:>7}{_fmt_us(r['decode_us']):>10}"
+          f"{r['decode_steps']:>6}{r['drafted']:>8}{r['accepted']:>9}"
+          f"{_fmt_us(r['total_us']):>10}  {r['finish_reason'] or '-'}")
+  counters = sorted({e["name"] for e in events if e.get("ph") == "C"})
+  if counters:
+    lines.append("")
+    lines.append("counter tracks: " + ", ".join(counters))
+  return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m easyparallellibrary_tpu.observability.report",
+      description="Latency-breakdown summary of an exported trace "
+                  "(observability/trace.py JSON).")
+  parser.add_argument("trace", help="path to the exported trace JSON")
+  args = parser.parse_args(argv)
+  print(format_report(load_events(args.trace)))
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -1,0 +1,467 @@
+"""Host-side span tracer with Chrome-trace-event / Perfetto JSON export.
+
+The reference's observability story is TF summaries plus RunMetadata
+FULL_TRACE capture (epl/parallel/hooks.py:593-664); this repo had
+outgrown that with four disjoint half-instruments (StepProfiler,
+FlopsProfiler, ServingStats, two metric sinks) none of which could
+answer "where did this request's latency go".  The tracer is the one
+event substrate they all share:
+
+* **spans** — paired B/E duration events, via the :meth:`Tracer.span`
+  context manager (host phases: data-next, step dispatch, checkpoint
+  stage/commit) or :meth:`Tracer.span_at` with explicit timestamps
+  (per-slot serving timelines, where one fused device step covers many
+  requests and the per-slot spans share its start/end);
+* **instants** — point events (request submit, first token, sentinel
+  escalation, watchdog timeout);
+* **counter tracks** — numeric series (active slots, accepted draft
+  tokens) Perfetto renders as graphs.
+
+Design constraints, in order:
+
+1. **Zero device syncs on the hot path.**  Nothing here touches a
+   ``jax.Array``; timestamps come from ``time.perf_counter_ns`` and
+   every argument recorded is already a host value.  The tracer can run
+   inside ``jax.transfer_guard_device_to_host("disallow")``.
+2. **Bounded memory.**  Events live in a ring buffer
+   (``observability.ring_capacity``); a long run keeps the most recent
+   window — exactly the window a post-mortem needs ("what happened
+   between step 400 and the rollback at 412").
+3. **Cheap when off.**  A disabled tracer's ``span()`` returns a
+   module-level null context manager: one attribute read and no
+   allocation, so instrumentation can stay unconditionally in hot
+   loops.
+4. **Leader-only export.**  Every process records (cheap), only
+   process 0 writes the JSON — the metrics writers' rule
+   (epl/parallel/hooks.py:542).
+
+The export is standard Chrome trace-event JSON: load it at
+``ui.perfetto.dev`` or ``chrome://tracing``.  Device-side XLA timelines
+are attached with :meth:`Tracer.xla_trace`, which brackets a
+``jax.profiler`` capture with a host span so the two timelines
+correlate by wall clock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+# Event tuples in the ring: (ph, name, cat, ts_us, tid, args_or_None).
+# Dicts are only built at export — the hot path appends one tuple.
+_Event = Tuple[str, str, str, float, int, Optional[Dict[str, Any]]]
+
+
+class _NullSpan:
+  """No-op context manager returned by a disabled tracer's ``span()``."""
+  __slots__ = ()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+  """Live span handle: records E on exit (always, even on exceptions,
+  so an error escaping a phase still closes its span)."""
+  __slots__ = ("_tracer", "_name", "_cat", "_tid", "_args")
+
+  def __init__(self, tracer: "Tracer", name: str, cat: str, tid: int,
+               args: Optional[Dict[str, Any]] = None):
+    self._tracer = tracer
+    self._name = name
+    self._cat = cat
+    self._tid = tid
+    self._args = args
+
+  def __enter__(self):
+    t = self._tracer
+    t._append("B", self._name, self._cat, t.now_us(), self._tid,
+              self._args)
+    return self
+
+  def __exit__(self, *exc):
+    t = self._tracer
+    t._append("E", self._name, self._cat, t.now_us(), self._tid, None)
+    return False
+
+
+class Tracer:
+  """Ring-buffered host-side span tracer (module docstring).
+
+  ``sample_rate`` in (0, 1] drives deterministic sampling of the
+  per-step train-loop phases: fit() makes ONE decision per step with
+  :meth:`sample_tick` and gates all of that step's phase spans on it
+  (the ``record=`` argument), so a sampled step keeps its FULL phase
+  set — including phases only some steps reach (host sync on log
+  boundaries) — and a long run can keep per-step phases at, say, 1%
+  without losing the request-lifecycle and checkpoint events that are
+  always recorded.  A bare ``span(..., sample=True)`` ticks an
+  accumulator keyed by its own span name, for standalone call sites
+  that sample one recurring span.
+  """
+
+  def __init__(self, *, enabled: bool = True, ring_capacity: int = 65536,
+               sample_rate: float = 1.0, trace_path: str = ""):
+    if ring_capacity < 1:
+      raise ValueError(f"ring_capacity must be >= 1: {ring_capacity}")
+    if not 0.0 < sample_rate <= 1.0:
+      raise ValueError(f"sample_rate must be in (0, 1]: {sample_rate}")
+    self.enabled = enabled
+    self.ring_capacity = ring_capacity
+    self.sample_rate = sample_rate
+    self.trace_path = trace_path
+    self._events: "deque[_Event]" = deque(maxlen=ring_capacity)
+    self._tracks: Dict[str, int] = {"main": 0}
+    # The watchdog monitor thread records instants while the main
+    # thread records spans, so track registration (two unsynchronized
+    # first-uses could claim the same tid) and the append/eviction
+    # accounting (`+=` is not GIL-atomic) share one lock.  Event rates
+    # are per-step-scale, not per-token, so the cost is noise; the
+    # cached track() path stays a lock-free dict read.
+    self._lock = threading.Lock()
+    self._t0_ns = time.perf_counter_ns()
+    self._sample_accs: Dict[str, float] = {}
+    # Eviction accounting off the hot path: one int increment per
+    # append; `dropped` is derived at read time.
+    self._n_appended = 0
+
+  # ------------------------------------------------------------- recording
+
+  def now_us(self) -> float:
+    """Microseconds since tracer creation (host monotonic clock)."""
+    return (time.perf_counter_ns() - self._t0_ns) / 1e3
+
+  def track(self, name: Optional[str]) -> int:
+    """tid for a named track (registered on first use; exported as a
+    thread-name metadata event so Perfetto labels the row)."""
+    if not name:
+      return 0
+    tid = self._tracks.get(name)
+    if tid is None:
+      with self._lock:
+        tid = self._tracks.get(name)
+        if tid is None:
+          tid = len(self._tracks)
+          self._tracks[name] = tid
+    return tid
+
+  @property
+  def dropped(self) -> int:
+    """Events evicted by the ring so far (for the export note)."""
+    return self._n_appended - len(self._events)
+
+  def _append(self, ph: str, name: str, cat: str, ts: float, tid: int,
+              args: Optional[Dict[str, Any]]):
+    with self._lock:
+      self._n_appended += 1
+      self._events.append((ph, name, cat, ts, tid, args))
+
+  def sample_tick(self, key: str = "") -> bool:
+    """Advance the deterministic sampling accumulator for ``key`` and
+    return whether this tick records.  fit() calls this once per step
+    and gates all of that step's phase spans on the result (``record=``),
+    so sampled steps keep their full phase set even for phases a given
+    step only sometimes reaches (host sync on log boundaries)."""
+    if not self.enabled:
+      return False
+    if self.sample_rate >= 1.0:
+      return True
+    acc = self._sample_accs.get(key, 0.0) + self.sample_rate
+    if acc < 1.0:
+      self._sample_accs[key] = acc
+      return False
+    self._sample_accs[key] = acc - 1.0
+    return True
+
+  def span(self, name: str, cat: str = "", track: Optional[str] = None,
+           sample: bool = False, args: Optional[Dict[str, Any]] = None,
+           record: bool = True):
+    """Context manager recording a B/E pair around the body.
+    ``record=False`` returns the null span — for call sites that made a
+    per-step sampling decision with :meth:`sample_tick` up front.  With
+    ``sample=True`` the span ticks its own name's accumulator instead."""
+    if not self.enabled or not record:
+      return _NULL_SPAN
+    if sample and not self.sample_tick(name):
+      return _NULL_SPAN
+    return _Span(self, name, cat, self.track(track), args)
+
+  def span_at(self, name: str, t0_us: float, t1_us: float, cat: str = "",
+              track: Optional[str] = None,
+              args: Optional[Dict[str, Any]] = None):
+    """Record a completed span with explicit timestamps — for work whose
+    duration is known only after the fact (one fused device step covers
+    every serving slot; each slot's span shares its bounds)."""
+    if not self.enabled:
+      return
+    tid = self.track(track) if track else 0
+    with self._lock:
+      append = self._events.append
+      append(("B", name, cat, t0_us, tid, args))
+      append(("E", name, cat, t1_us if t1_us >= t0_us else t0_us, tid,
+              None))
+      self._n_appended += 2
+
+  def begin(self, name: str, cat: str = "", track: Optional[str] = None,
+            args: Optional[Dict[str, Any]] = None):
+    """Open a long-lived span explicitly (request lifecycle: opened at
+    admission, closed at retirement many engine steps later)."""
+    if self.enabled:
+      self._append("B", name, cat, self.now_us(), self.track(track), args)
+
+  def end(self, name: str, cat: str = "", track: Optional[str] = None,
+          args: Optional[Dict[str, Any]] = None):
+    """Close a span opened with :meth:`begin` (args merge with the B's
+    in trace viewers — retirement reason rides the E)."""
+    if self.enabled:
+      self._append("E", name, cat, self.now_us(), self.track(track), args)
+
+  def instant(self, name: str, cat: str = "", track: Optional[str] = None,
+              args: Optional[Dict[str, Any]] = None):
+    if self.enabled:
+      self._append("i", name, cat, self.now_us(), self.track(track), args)
+
+  def counter(self, name: str, value: Union[int, float], cat: str = ""):
+    """One sample of a numeric counter track (Perfetto draws a graph)."""
+    if self.enabled:
+      self._append("C", name, cat, self.now_us(), 0, {"value": value})
+
+  @contextlib.contextmanager
+  def xla_trace(self, log_dir: str, name: str = "xla_trace"):
+    """Bracket a ``jax.profiler`` device-trace capture with a host span,
+    so the XLA timeline (TensorBoard/Perfetto from ``log_dir``) and this
+    tracer's host timeline correlate.  The capture runs whether or not
+    the tracer is enabled — the span is recorded only when it is."""
+    import jax
+    from easyparallellibrary_tpu.utils.logging import get_logger
+    jax.profiler.start_trace(log_dir)
+    t0 = self.now_us()
+    try:
+      yield
+    finally:
+      jax.profiler.stop_trace()
+      self.span_at(name, t0, self.now_us(), cat="xla",
+                   args={"log_dir": os.path.abspath(log_dir)})
+      get_logger().info("xla trace written to %s", log_dir)
+
+  # --------------------------------------------------------------- export
+
+  def events(self) -> List[Dict[str, Any]]:
+    """Chrome-trace-event dicts: thread-name metadata first, then the
+    ring's events sorted by timestamp (spans recorded retroactively via
+    :meth:`span_at` land in buffer order, not time order; the stable
+    sort restores B-before-E at equal timestamps)."""
+    import jax
+    pid = jax.process_index()
+    with self._lock:  # a concurrent append must not mutate mid-snapshot
+      events = list(self._events)
+      tracks = sorted(self._tracks.items(), key=lambda kv: kv[1])
+    out: List[Dict[str, Any]] = []
+    for name, tid in tracks:
+      out.append({"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                  "args": {"name": name}})
+      out.append({"ph": "M", "name": "thread_sort_index", "pid": pid,
+                  "tid": tid, "args": {"sort_index": tid}})
+    for ph, name, cat, ts, tid, args in sorted(events, key=lambda e: e[3]):
+      ev: Dict[str, Any] = {"ph": ph, "name": name, "ts": ts,
+                            "pid": pid, "tid": tid}
+      if cat:
+        ev["cat"] = cat
+      if ph == "i":
+        ev["s"] = "t"
+      if args is not None:
+        ev["args"] = args
+      out.append(ev)
+    return out
+
+  def export(self, path: Optional[str] = None) -> Optional[str]:
+    """Write the trace JSON (leader only; non-leaders no-op and return
+    None).  Load the file at ``ui.perfetto.dev``."""
+    import jax
+    from easyparallellibrary_tpu.utils.logging import get_logger
+    path = path or self.trace_path
+    if not path:
+      raise ValueError("no trace path: pass export(path) or set "
+                       "observability.trace_path")
+    if jax.process_index() != 0:
+      return None
+    doc = {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+    if self.dropped:
+      doc["otherData"] = {
+          "dropped_events": self.dropped,
+          "note": "ring buffer evicted oldest events; raise "
+                  "observability.ring_capacity for a longer window"}
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+      json.dump(doc, f)
+    os.replace(tmp, path)
+    get_logger().info(
+        "trace: %d events -> %s (open at ui.perfetto.dev)",
+        len(self._events), path)
+    return path
+
+  def clear(self):
+    with self._lock:
+      self._events.clear()
+      self._n_appended = 0
+
+
+# ------------------------------------------------------- global tracer --
+
+# One ambient tracer, like logging: instrumentation sites call
+# get_tracer() and stay cheap when it is disabled.  `install()` pins an
+# explicit tracer (wins over config); `ensure_configured()` auto-builds
+# from the active observability.* config and rebuilds/removes the
+# auto-built one when the config changes.
+_DISABLED = Tracer(enabled=False, ring_capacity=1)
+_tracer: Optional[Tracer] = None
+_auto_sig: Optional[Tuple] = None
+
+
+def get_tracer() -> Tracer:
+  """The ambient tracer (never None; a disabled singleton when nothing
+  is configured)."""
+  return _tracer if _tracer is not None else _DISABLED
+
+
+def install(tracer: Optional[Tracer]) -> Optional[Tracer]:
+  """Pin `tracer` as the ambient tracer (None = uninstall).  An
+  explicitly installed tracer wins over config auto-configuration."""
+  global _tracer, _auto_sig
+  _tracer = tracer
+  _auto_sig = None
+  return tracer
+
+
+def reset():
+  """Drop any ambient tracer (tests; Env resets do not reach here)."""
+  install(None)
+
+
+def ensure_configured(config=None) -> Tracer:
+  """Reconcile the ambient tracer with ``config.observability`` (the
+  active Env's config when None): enable/rebuild it when the config asks
+  for tracing, drop an auto-built tracer when it no longer does.  An
+  explicitly :func:`install`-ed tracer is left alone.  Called by
+  ``fit()`` and the serving engine at entry, so setting
+  ``observability.enabled`` is all a run needs.
+
+  Only the AMBIENT Env config may tear down or rebuild an existing
+  auto-built tracer (both discard the ring).  A component constructed
+  with its own explicit config — an engine built mid-fit with serving
+  knobs whose observability group is default-off — can enable tracing
+  when none exists, but must not silently drop the run's recorded
+  events or stop the instrumentation every other site records into."""
+  global _tracer, _auto_sig
+  if _tracer is not None and _auto_sig is None:
+    return _tracer  # explicit install wins
+  from easyparallellibrary_tpu.env import Env
+  if config is None:
+    config = Env.get().config
+    ambient = True
+  else:
+    ambient = config is Env.get().config
+  obs = config.observability
+  if not obs.enabled:
+    if _auto_sig is not None and ambient:
+      _tracer = None
+      _auto_sig = None
+    return get_tracer()
+  sig = (obs.ring_capacity, obs.sample_rate, obs.trace_path)
+  if _tracer is None:
+    _tracer = Tracer(enabled=True, ring_capacity=obs.ring_capacity,
+                     sample_rate=obs.sample_rate,
+                     trace_path=obs.trace_path)
+    _auto_sig = sig
+  elif _auto_sig != sig and ambient:
+    _tracer = Tracer(enabled=True, ring_capacity=obs.ring_capacity,
+                     sample_rate=obs.sample_rate,
+                     trace_path=obs.trace_path)
+    _auto_sig = sig
+  return _tracer
+
+
+# ----------------------------------------------------- schema validation --
+
+_REQUIRED_KEYS = ("ph", "name", "pid", "tid")
+
+
+def validate_trace(trace: Union[str, Dict[str, Any], List[Dict[str, Any]]]
+                   ) -> List[Dict[str, Any]]:
+  """Schema-validate a Chrome-trace JSON export; returns the event list
+  or raises ``ValueError`` naming every problem.
+
+  Checks: top-level shape, required keys per event, monotonically
+  non-decreasing ``ts``, and strict B/E pairing per (pid, tid) — every
+  E closes the innermost open B of the same name, nothing left open.
+  (``make trace-demo``'s quick test runs this over a real emitted
+  trace.)
+  """
+  if isinstance(trace, str):
+    with open(trace) as f:
+      trace = json.load(f)
+  if isinstance(trace, dict):
+    if "traceEvents" not in trace:
+      raise ValueError("trace JSON object lacks the 'traceEvents' key")
+    events = trace["traceEvents"]
+  else:
+    events = trace
+  if not isinstance(events, list):
+    raise ValueError(f"traceEvents must be a list; got {type(events)}")
+  problems: List[str] = []
+  last_ts: Optional[float] = None
+  stacks: Dict[Tuple[Any, Any], List[str]] = {}
+  for i, ev in enumerate(events):
+    if not isinstance(ev, dict):
+      problems.append(f"event {i}: not an object")
+      continue
+    missing = [k for k in _REQUIRED_KEYS if k not in ev]
+    if missing:
+      problems.append(f"event {i}: missing {missing}")
+      continue
+    ph = ev["ph"]
+    if ph == "M":
+      continue  # metadata events carry no timestamp
+    if "ts" not in ev:
+      problems.append(f"event {i} ({ph} {ev['name']!r}): missing 'ts'")
+      continue
+    ts = ev["ts"]
+    if last_ts is not None and ts < last_ts:
+      problems.append(
+          f"event {i} ({ph} {ev['name']!r}): ts {ts} < previous {last_ts} "
+          f"(not monotonic)")
+    last_ts = ts
+    key = (ev["pid"], ev["tid"])
+    stack = stacks.setdefault(key, [])
+    if ph == "B":
+      stack.append(ev["name"])
+    elif ph == "E":
+      if not stack:
+        problems.append(f"event {i}: E {ev['name']!r} with no open B "
+                        f"on pid/tid {key}")
+      elif stack[-1] != ev["name"]:
+        problems.append(
+            f"event {i}: E {ev['name']!r} does not close the innermost "
+            f"open B {stack[-1]!r} on pid/tid {key}")
+        stack.pop()
+      else:
+        stack.pop()
+  for key, stack in stacks.items():
+    if stack:
+      problems.append(f"unclosed span(s) {stack} on pid/tid {key}")
+  if problems:
+    raise ValueError("invalid trace:\n  " + "\n  ".join(problems))
+  return events

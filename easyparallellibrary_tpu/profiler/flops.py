@@ -134,7 +134,13 @@ class FlopsProfiler:
   def __init__(self, flops_per_step: Optional[float] = None,
                every_n_steps: int = 100,
                comm_bytes_per_step: Optional[float] = None,
-               link_bytes_per_s: Optional[float] = None):
+               link_bytes_per_s: Optional[float] = None,
+               registry=None):
+    # Optional MetricRegistry (observability/registry.py): each periodic
+    # stats line also publishes under the namespaced schema — timing/MFU
+    # as train/*, the collective-traffic counters as comm/*, and the
+    # health counters as resilience/*.
+    self.registry = registry
     self.flops_per_step = flops_per_step
     self.every_n_steps = every_n_steps
     # Collective-traffic counters for the comm-share line: what fraction
@@ -204,4 +210,8 @@ class FlopsProfiler:
     if self.io_retries:
       stats["io_retries"] = float(self.io_retries)
     get_logger().info("flops profiler: %s", stats)
+    if self.registry is not None:
+      from easyparallellibrary_tpu.observability.registry import (
+          split_namespaces)
+      self.registry.publish_many(self._step, split_namespaces(stats))
     return stats

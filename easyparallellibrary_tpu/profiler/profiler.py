@@ -10,11 +10,8 @@ RunMetadata FULL_TRACE analog.
 
 from __future__ import annotations
 
-import contextlib
 import time
 from typing import Callable, Dict, Optional
-
-import jax
 
 from easyparallellibrary_tpu.parallel.pipeline import bubble_fraction
 from easyparallellibrary_tpu.profiler.flops import (
@@ -94,12 +91,24 @@ class StepProfiler:
       out["io_retries"] = float(self.io_retries)
     return out
 
-  @contextlib.contextmanager
+  def publish(self, registry, step: int):
+    """Publish :meth:`summary` through a MetricRegistry
+    (observability/registry.py): timing under ``train/*``, the health
+    counters under ``resilience/*``.  ``fit()`` calls this for the
+    auto-built registry at the end of a run."""
+    out = self.summary()
+    if not out:
+      return
+    from easyparallellibrary_tpu.observability.registry import (
+        split_namespaces)
+    registry.publish_many(step, split_namespaces(out))
+
   def trace(self, log_dir: str):
-    """Capture an XLA trace viewable in TensorBoard/Perfetto."""
-    jax.profiler.start_trace(log_dir)
-    try:
-      yield
-    finally:
-      jax.profiler.stop_trace()
-      get_logger().info("xla trace written to %s", log_dir)
+    """Capture an XLA trace viewable in TensorBoard/Perfetto.
+
+    Delegates to the ambient tracer's :meth:`Tracer.xla_trace`, which
+    brackets the capture with a host span when span tracing is enabled
+    — the device timeline in ``log_dir`` and the host timeline in the
+    exported trace JSON then correlate by wall clock."""
+    from easyparallellibrary_tpu.observability import trace as trace_lib
+    return trace_lib.get_tracer().xla_trace(log_dir)

@@ -144,6 +144,12 @@ class ServingStats:
                    / (tr.new_tokens - 1))
     return out
 
+  def publish(self, registry, step: int):
+    """Publish :meth:`summary` under ``serving/*`` through a
+    MetricRegistry (observability/registry.py) — the engine calls this
+    when it finishes a ``run()`` drive with a registry attached."""
+    registry.publish(step, self.summary(), "serving")
+
   def summary(self) -> Dict[str, float]:
     ttfts, itls = self._ttfts(), self._itls()
     busy = max(self.busy_time_s, 1e-9)

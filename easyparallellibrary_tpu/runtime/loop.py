@@ -20,13 +20,14 @@ from typing import Any, Callable, Dict, Iterable, Optional
 import jax
 
 from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.observability import trace as trace_lib
+from easyparallellibrary_tpu.observability.registry import split_namespaces
 from easyparallellibrary_tpu.profiler.profiler import StepProfiler
 from easyparallellibrary_tpu.runtime import resilience as resilience_lib
 from easyparallellibrary_tpu.runtime import saver
 from easyparallellibrary_tpu.utils.logging import get_logger
 from easyparallellibrary_tpu.utils.retry import (
     PERMANENT_IO_EXCEPTIONS, TRANSIENT_EXCEPTIONS)
-
 
 def _accepts_start_step(factory: Callable) -> bool:
   """Whether a data factory declares a `start_step` parameter (the
@@ -74,9 +75,27 @@ def fit(step_fn: Callable,
   Returns (state, last_metrics).
   """
   log = get_logger()
-  res = Env.get().config.resilience
+  config = Env.get().config
+  res = config.resilience
+  obs = config.observability
+  tracer = trace_lib.ensure_configured(config)
   rng = rng if rng is not None else jax.random.PRNGKey(0)
   start_step = int(state.step) if hasattr(state, "step") else 0
+
+  # Never silently unlogged (observability.metrics_jsonl): with a
+  # checkpoint dir and no explicit writer, build the leader-only JSONL
+  # sink under the checkpoint dir behind the namespaced registry.  An
+  # explicitly passed metrics_writer keeps its legacy flat keys.
+  own_registry = None
+  if metrics_writer is None and checkpoint_dir and obs.metrics_jsonl:
+    from easyparallellibrary_tpu.observability.registry import (
+        MetricRegistry)
+    from easyparallellibrary_tpu.utils.metrics_writer import MetricsWriter
+    # Flushing float()s buffered device values (a host sync), so the
+    # period must stay > 1 even when periodic logging is off.
+    own_registry = MetricRegistry(MetricsWriter(
+        os.path.join(checkpoint_dir, "metrics.jsonl"),
+        flush_every=log_every if log_every > 0 else 50))
 
   def _ckpt_tree(st):
     # Full training state: resuming with fresh optimizer moments would
@@ -278,30 +297,41 @@ def fit(step_fn: Callable,
         raise SystemExit(0)
       if watchdog is not None:
         watchdog.arm(step_idx)
-      try:
-        batch = _next_with_retry(it)
-      except StopIteration:
-        if step_idx == start_step and start_step > 0:
-          # The resumed stream produced nothing: almost always a
-          # skip_records that overran the shard (missing the modulo in the
-          # recipe above) — restarting at record 0 would silently train on
-          # a different data order than the uninterrupted run.
-          log.warning(
-              "data factory resumed at start_step=%d yielded no batches; "
-              "restarting the stream from its beginning.  If the factory "
-              "skips records, skip (start_step * records_per_step) MODULO "
-              "the shard's record count.", start_step)
-        # Epoch boundary: restart the stream from its beginning.
-        it = _make_iter(0)
+      # One sampling decision per step: every train/* phase span below
+      # gates on it, so a sampled step keeps its FULL phase set even
+      # when a phase only runs some steps (host sync on log boundaries).
+      step_rec = tracer.sample_tick("train")
+      with tracer.span("train/data_next", cat="train", track="train",
+                       record=step_rec):
         try:
           batch = _next_with_retry(it)
         except StopIteration:
-          raise RuntimeError(
-              "data iterator exhausted and could not be restarted; pass a "
-              "re-iterable (list) or a zero-arg iterator factory to fit() "
-              "for multi-epoch runs") from None
-      state, metrics = step_fn(state, batch,
-                               jax.random.fold_in(rng, step_idx))
+          if step_idx == start_step and start_step > 0:
+            # The resumed stream produced nothing: almost always a
+            # skip_records that overran the shard (missing the modulo in
+            # the recipe above) — restarting at record 0 would silently
+            # train on a different data order than the uninterrupted run.
+            log.warning(
+                "data factory resumed at start_step=%d yielded no "
+                "batches; restarting the stream from its beginning.  If "
+                "the factory skips records, skip (start_step * "
+                "records_per_step) MODULO the shard's record count.",
+                start_step)
+          # Epoch boundary: restart the stream from its beginning.
+          it = _make_iter(0)
+          try:
+            batch = _next_with_retry(it)
+          except StopIteration:
+            raise RuntimeError(
+                "data iterator exhausted and could not be restarted; "
+                "pass a re-iterable (list) or a zero-arg iterator "
+                "factory to fit() for multi-epoch runs") from None
+      # The span measures DISPATCH (async): device time surfaces at the
+      # next host sync, which the flush/log spans below then cover.
+      with tracer.span("train/step_dispatch", cat="train", track="train",
+                       record=step_rec):
+        state, metrics = step_fn(state, batch,
+                                 jax.random.fold_in(rng, step_idx))
       if watchdog is not None:
         watchdog.disarm()
       if check_every and (step_idx + 1) % check_every == 0 \
@@ -314,6 +344,11 @@ def fit(step_fn: Callable,
             profiler.note_bad_step(total_bad - fed["bad"])
           fed["bad"] = total_bad
         if bad >= res.max_bad_steps:
+          tracer.instant(
+              "resilience/sentinel_escalation", cat="resilience",
+              track="train",
+              args={"bad_steps": bad, "step": step_idx + 1,
+                    "action": "rollback" if res.rollback else "raise"})
           if not res.rollback:
             raise RuntimeError(
                 f"{bad} consecutive non-finite steps at step "
@@ -327,7 +362,9 @@ def fit(step_fn: Callable,
                 f"{rollbacks['consecutive']} rollbacks without a clean "
                 f"window in between — the anomaly is not transient; "
                 f"giving up at step {step_idx + 1}")
-          state = _rollback(state, bad, step_idx)
+          with tracer.span("resilience/rollback", cat="resilience",
+                           track="train"):
+            state = _rollback(state, bad, step_idx)
           fed["bad"] = 0  # the sentinel counters were reset with the state
           rollbacks["trigger"] = step_idx
           step_idx = int(state.step)
@@ -341,6 +378,10 @@ def fit(step_fn: Callable,
             fed["retries"]:
           profiler.note_retry(io_retries["n"] - fed["retries"])
           fed["retries"] = io_retries["n"]
+      out = metrics
+      if io_retries["n"] or rollbacks["total"]:
+        out = {**metrics, "io_retries": io_retries["n"],
+               "rollbacks": rollbacks["total"]}
       if metrics_writer is not None:
         # Metrics arriving here are already merged global values
         # (parallel/metrics.py) — the writer is a pure sink, matching the
@@ -348,16 +389,23 @@ def fit(step_fn: Callable,
         # (epl/parallel/hooks.py:593-664).  Writers buffer raw device
         # values; construct them with flush_every=N so the host sync only
         # happens every N steps and async dispatch survives.  Host-side
-        # resilience counters ride along when active.
-        out = metrics
-        if io_retries["n"] or rollbacks["total"]:
-          out = {**metrics, "io_retries": io_retries["n"],
-                 "rollbacks": rollbacks["total"]}
-        metrics_writer.write(step_idx + 1, out)
+        # resilience counters ride along when active.  (Legacy flat
+        # keys; the auto-built registry below uses the namespaced
+        # schema, observability/registry.py.)
+        with tracer.span("train/metrics_flush", cat="train",
+                         track="train", record=step_rec):
+          metrics_writer.write(step_idx + 1, out)
+      elif own_registry is not None:
+        with tracer.span("train/metrics_flush", cat="train",
+                         track="train", record=step_rec):
+          own_registry.publish_many(step_idx + 1, split_namespaces(out))
       if log_every and (step_idx + 1) % log_every == 0:
-        loss = metrics.get("loss")
-        log.info("step %d: loss %s", step_idx + 1,
-                 f"{float(loss):.5f}" if loss is not None else "n/a")
+        # float(loss) is the loop's periodic host sync point.
+        with tracer.span("train/host_sync", cat="train", track="train",
+                         record=step_rec):
+          loss = metrics.get("loss")
+          log.info("step %d: loss %s", step_idx + 1,
+                   f"{float(loss):.5f}" if loss is not None else "n/a")
       if (checkpoint_dir and checkpoint_every
           and (step_idx + 1) % checkpoint_every == 0):
         saver.save_checkpoint(checkpoint_dir, _ckpt_tree(state),
@@ -383,6 +431,24 @@ def fit(step_fn: Callable,
       signal.signal(signal.SIGTERM, prev_handler)
     if watchdog is not None:
       watchdog.close()
+    if own_registry is not None:
+      try:
+        if profiler is not None and hasattr(profiler, "publish"):
+          # End-of-run StepProfiler rollup joins the same schema.
+          profiler.publish(own_registry, step_idx)
+        own_registry.close()
+      except Exception as e:  # must not mask the real exit
+        log.error("metrics flush on exit failed: %s", e)
+    if tracer.enabled:
+      # Export on EVERY exit path: the trace matters most when the run
+      # died ("what happened between step 400 and the rollback").
+      path = obs.trace_path or (os.path.join(checkpoint_dir, "trace.json")
+                                if checkpoint_dir else "")
+      if path:
+        try:
+          tracer.export(path)
+        except Exception as e:  # must not mask the real exit
+          log.error("trace export to %s failed: %s", path, e)
   if profiler is not None and profiler.summary():
     log.info("training profile: %s", profiler.summary())
   return state, metrics

@@ -248,6 +248,14 @@ class StepWatchdog:
 
   def _fire(self, step: int):
     self.timeouts_fired += 1
+    # Instant event from the monitor thread (its own trace track): the
+    # wedged window shows up IN the timeline next to whatever phase
+    # span never closed.
+    from easyparallellibrary_tpu.observability import trace as trace_lib
+    trace_lib.get_tracer().instant(
+        "resilience/watchdog_timeout", cat="resilience",
+        track="resilience/watchdog",
+        args={"step": step, "timeout_s": self.timeout_s})
     log = get_logger()
     try:
       devices = len(jax.devices())

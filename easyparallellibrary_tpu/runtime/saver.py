@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from easyparallellibrary_tpu import constants
+from easyparallellibrary_tpu.observability import trace as trace_lib
 from easyparallellibrary_tpu.utils.logging import get_logger
 from easyparallellibrary_tpu.utils.pytree import (
     path_str, tree_paths_and_leaves)
@@ -202,6 +203,9 @@ def _quarantine(path: str):
     os.replace(path, target)
     get_logger().warning("quarantined corrupt checkpoint %s -> %s",
                          path, target)
+    trace_lib.get_tracer().instant(
+        "checkpoint/quarantine", cat="checkpoint", track="checkpoint",
+        args={"path": path})
   except OSError as e:  # pragma: no cover - racing cleanup
     get_logger().warning("could not quarantine %s: %s", path, e)
 
@@ -365,34 +369,44 @@ def save_checkpoint(directory: str, tree, step: Optional[int] = None,
     shard_id += 1
     bucket, bucket_bytes = [], 0
 
-  for path, leaf in flat:
-    # Size from the unboxed value: metadata boxes expose no shape/dtype,
-    # and a 4-byte default would put everything in one bucket, defeating
-    # the host-memory bound.
-    value = leaf.unbox() if _is_box(leaf) else leaf
-    nbytes = int(np.prod(getattr(value, "shape", ()) or (1,))) * \
-        jnp.dtype(getattr(value, "dtype", jnp.float32)).itemsize
-    if bucket and bucket_bytes + nbytes > limit:
-      flush()
-    bucket.append((path, leaf))
-    bucket_bytes += nbytes
-  flush()
+  tracer = trace_lib.get_tracer()
+  # Staging (leaf fetch + shard writes + index, all in step_N.tmp) vs
+  # commit (the atomic rename) as separate spans: the trace shows
+  # whether a slow checkpoint spent its time in device->host IO or in
+  # the filesystem's rename/fsync path.
+  with tracer.span("checkpoint/stage", cat="checkpoint",
+                   track="checkpoint", args={"step": step_num}):
+    for path, leaf in flat:
+      # Size from the unboxed value: metadata boxes expose no
+      # shape/dtype, and a 4-byte default would put everything in one
+      # bucket, defeating the host-memory bound.
+      value = leaf.unbox() if _is_box(leaf) else leaf
+      nbytes = int(np.prod(getattr(value, "shape", ()) or (1,))) * \
+          jnp.dtype(getattr(value, "dtype", jnp.float32)).itemsize
+      if bucket and bucket_bytes + nbytes > limit:
+        flush()
+      bucket.append((path, leaf))
+      bucket_bytes += nbytes
+    flush()
+    if is_leader:
+      retry_call(lambda: _write_index(write_dir, index),
+                 what="checkpoint index write")
+      _fsync_path(write_dir, is_dir=True)
 
-  if is_leader:
-    retry_call(lambda: _write_index(write_dir, index),
-               what="checkpoint index write")
-    _fsync_path(write_dir, is_dir=True)
-    if atomic:
-      # Commit: one atomic rename.  Everything inside is already fsynced,
-      # so after the parent-dir fsync the checkpoint either exists whole
-      # or not at all.
-      if os.path.isdir(final_dir):
-        shutil.rmtree(final_dir)
-      os.replace(write_dir, final_dir)
-    _fsync_path(directory, is_dir=True)
-    get_logger().info("saved checkpoint: %s (%d leaves, %d shards)",
-                      final_dir, len(index["leaves"]), shard_id)
-    _apply_retention(directory, keep_last)
+  with tracer.span("checkpoint/commit", cat="checkpoint",
+                   track="checkpoint", args={"step": step_num}):
+    if is_leader:
+      if atomic:
+        # Commit: one atomic rename.  Everything inside is already
+        # fsynced, so after the parent-dir fsync the checkpoint either
+        # exists whole or not at all.
+        if os.path.isdir(final_dir):
+          shutil.rmtree(final_dir)
+        os.replace(write_dir, final_dir)
+      _fsync_path(directory, is_dir=True)
+      get_logger().info("saved checkpoint: %s (%d leaves, %d shards)",
+                        final_dir, len(index["leaves"]), shard_id)
+      _apply_retention(directory, keep_last)
   if multihost:
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices(f"epl_save_{directory}_{step_num}")
@@ -502,9 +516,11 @@ def restore_checkpoint(directory: str,
   Returns ``(tree, step)`` with `step` taken from the checkpoint
   actually restored (callers must not assume it is the newest on disk).
   """
-  for path in _walk_valid_checkpoints(directory):
-    return _restore_from(path, target, shardings, assign_map,
-                         slice_offsets)
+  with trace_lib.get_tracer().span("checkpoint/restore",
+                                   cat="checkpoint", track="checkpoint"):
+    for path in _walk_valid_checkpoints(directory):
+      return _restore_from(path, target, shardings, assign_map,
+                           slice_offsets)
 
 
 def _restore_from(directory: str,

@@ -49,11 +49,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.observability import trace as trace_lib
 from easyparallellibrary_tpu.serving import kv_cache as kv_lib
 from easyparallellibrary_tpu.serving._capabilities import (
     check_draft_fits_chunk, check_servable)
 from easyparallellibrary_tpu.serving.scheduler import (
-    FCFSScheduler, FinishedRequest, Request)
+    FCFSScheduler, FinishedRequest, Request, _slot_track)
 from easyparallellibrary_tpu.utils.logging import get_logger
 
 
@@ -137,10 +138,14 @@ class ContinuousBatchingEngine:
                donate_cache: Optional[bool] = None,
                drafter=None, speculative: Optional[bool] = None,
                draft_model=None, draft_params=None,
-               stats=None, metrics_writer=None,
+               stats=None, metrics_writer=None, registry=None,
                config=None):
     cfg = model.cfg
-    conf = (config if config is not None else Env.get().config).serving
+    root_config = config if config is not None else Env.get().config
+    conf = root_config.serving
+    # Reconcile the ambient tracer with observability.* so a config-
+    # enabled run traces serving without any wiring at the call site.
+    trace_lib.ensure_configured(root_config)
     check_servable(cfg)
     self.model = model
     self.params = params
@@ -168,6 +173,9 @@ class ContinuousBatchingEngine:
         spec_k=self.drafter.k if self.drafter is not None else 0)
     self.stats = stats
     self.metrics_writer = metrics_writer
+    # Optional MetricRegistry (observability/registry.py): per-step
+    # records publish under serving/* through the one metric schema.
+    self.registry = registry
     if stats is not None:
       self.scheduler.on_admit = stats.note_admitted
       self.scheduler.on_first_token = stats.note_first_token
@@ -175,6 +183,10 @@ class ContinuousBatchingEngine:
           fin.uid, fin.new_tokens)
     self._kv, self._cursors = kv_lib.allocate_kv_cache(
         cfg, self.num_slots, self.chunk, mesh)
+    # Perfetto track name per slot (the scheduler's lifecycle spans and
+    # the engine's per-step spans must land on the same track);
+    # precomputed so the per-step tracing loop does no string work.
+    self._slot_tracks = [_slot_track(i) for i in range(self.num_slots)]
     self._steps = 0
     donate = conf.donate_cache if donate_cache is None else donate_cache
     if self.drafter is not None:
@@ -313,11 +325,40 @@ class ContinuousBatchingEngine:
   def has_work(self) -> bool:
     return self.scheduler.has_work
 
+  def _trace_slot_spans(self, tracer, plan, t0_us: float, t1_us: float,
+                        num_draft=None, n_committed=None):
+    """Per-slot timeline spans for one fused step: the single device
+    call covers every active slot, so each slot's prefill / decode /
+    speculate span shares its bounds and nests inside the request
+    lifecycle span opened at admission (scheduler._admit).  Speculating
+    slots carry drafted/accepted counts in their span args.  Host
+    values only — never called with device arrays."""
+    if not tracer.enabled:
+      return
+    for slot in np.nonzero(plan.num_valid)[0]:
+      slot = int(slot)
+      track = self._slot_tracks[slot]
+      if plan.prefilling[slot]:
+        tracer.span_at("prefill", t0_us, t1_us, cat="serving",
+                       track=track,
+                       args={"tokens": int(plan.num_valid[slot])})
+      elif num_draft is not None and int(num_draft[slot]) > 0:
+        tracer.span_at(
+            "speculate", t0_us, t1_us, cat="serving", track=track,
+            args={"drafted": int(num_draft[slot]),
+                  "accepted": int(n_committed[slot]) - 1})
+      else:
+        tracer.span_at("decode", t0_us, t1_us, cat="serving",
+                       track=track,
+                       args={"tok_index": int(plan.tok_index[slot])})
+
   def step(self) -> List[FinishedRequest]:
     """One engine iteration: plan -> [draft ->] fused device step ->
     commit.  Returns the requests that retired this iteration (empty
     when idle)."""
-    plan = self.scheduler.plan_step()
+    tracer = trace_lib.get_tracer()
+    with tracer.span("serving/plan", cat="serving", track="serving"):
+      plan = self.scheduler.plan_step()
     if plan is None:
       return []
     t0 = time.monotonic()
@@ -325,38 +366,59 @@ class ContinuousBatchingEngine:
     if self.drafter is not None:
       # Propose BEFORE the token block gains drafts: the draft model's
       # mirror call needs the same plan the target sees.
-      histories = self.scheduler.slot_histories(plan)
-      draft_tokens, num_draft = self.drafter.propose(plan, histories)
-      num_draft = np.minimum(
-          np.asarray(num_draft, np.int32), plan.draft_cap)
-      for slot in np.nonzero(num_draft)[0]:
-        nd = int(num_draft[slot])
-        plan.tokens[slot, 1:1 + nd] = draft_tokens[slot, :nd]
+      with tracer.span("serving/draft", cat="serving", track="serving"):
+        histories = self.scheduler.slot_histories(plan)
+        draft_tokens, num_draft = self.drafter.propose(plan, histories)
+        num_draft = np.minimum(
+            np.asarray(num_draft, np.int32), plan.draft_cap)
+        for slot in np.nonzero(num_draft)[0]:
+          nd = int(num_draft[slot])
+          plan.tokens[slot, 1:1 + nd] = draft_tokens[slot, :nd]
+      t0_us = tracer.now_us()
       committed, n_committed, self._kv, self._cursors = self._step_fn(
           self.params, self._kv, self._cursors, plan.tokens,
           plan.num_valid + num_draft, num_draft, plan.reset, plan.keys,
           plan.tok_index, plan.temperature, plan.top_k, plan.top_p)
+      committed = np.asarray(committed)
       n_committed = np.asarray(n_committed)
-      finished = self.scheduler.commit(np.asarray(committed), n_committed)
-      self.drafter.observe_commit(self._cursors)
+      t1_us = tracer.now_us()
+      tracer.span_at("serving/device_step", t0_us, t1_us, cat="serving",
+                     track="serving")
+      self._trace_slot_spans(tracer, plan, t0_us, t1_us,
+                             num_draft, n_committed)
+      with tracer.span("serving/commit", cat="serving", track="serving"):
+        finished = self.scheduler.commit(committed, n_committed)
+        self.drafter.observe_commit(self._cursors)
       speculated = num_draft > 0
       drafted = int(num_draft.sum())
       accepted = int((n_committed[speculated] - 1).sum())
     else:
+      t0_us = tracer.now_us()
       nxt, self._kv, self._cursors = self._step_fn(
           self.params, self._kv, self._cursors, plan.tokens,
           plan.num_valid, plan.reset, plan.keys, plan.tok_index,
           plan.temperature, plan.top_k, plan.top_p)
-      finished = self.scheduler.commit(np.asarray(nxt))
+      nxt = np.asarray(nxt)
+      t1_us = tracer.now_us()
+      tracer.span_at("serving/device_step", t0_us, t1_us, cat="serving",
+                     track="serving")
+      self._trace_slot_spans(tracer, plan, t0_us, t1_us)
+      with tracer.span("serving/commit", cat="serving", track="serving"):
+        finished = self.scheduler.commit(nxt)
     self._steps += 1
     dt = time.monotonic() - t0
+    if tracer.enabled:
+      tracer.counter("serving/active_slots", plan.active_slots)
+      if drafted:
+        tracer.counter("serving/drafted_tokens", drafted)
+        tracer.counter("serving/accepted_tokens", accepted)
     if self.stats is not None:
       self.stats.note_step(
           active_slots=plan.active_slots, num_slots=self.num_slots,
           prefill_tokens=plan.prefill_tokens,
           decode_tokens=plan.decode_tokens, step_time_s=dt,
           drafted_tokens=drafted, accepted_tokens=accepted)
-    if self.metrics_writer is not None:
+    if self.metrics_writer is not None or self.registry is not None:
       record = {
           "active_slots": plan.active_slots,
           "slot_occupancy": plan.active_slots / self.num_slots,
@@ -367,7 +429,11 @@ class ContinuousBatchingEngine:
       if self.drafter is not None:
         record["drafted_tokens"] = drafted
         record["accepted_tokens"] = accepted
-      self.metrics_writer.write(self._steps, record)
+      if self.metrics_writer is not None:
+        # Legacy flat keys (pre-registry callers depend on them).
+        self.metrics_writer.write(self._steps, record)
+      if self.registry is not None:
+        self.registry.publish(self._steps, record, "serving")
     return finished
 
   def run(self, max_steps: Optional[int] = None
@@ -381,4 +447,8 @@ class ContinuousBatchingEngine:
       for fin in self.step():
         out[fin.uid] = fin.tokens
       steps += 1
+    if self.registry is not None and self.stats is not None:
+      # End-of-drive rollup (tokens/s, TTFT/ITL percentiles, occupancy,
+      # speculation counters) under the serving/* namespace.
+      self.stats.publish(self.registry, self._steps)
     return out

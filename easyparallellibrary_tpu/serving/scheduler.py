@@ -34,6 +34,14 @@ from typing import Any, Deque, Dict, List, Optional
 import jax
 import numpy as np
 
+from easyparallellibrary_tpu.observability import trace as trace_lib
+
+
+def _slot_track(slot: int) -> str:
+  """Perfetto track name for one KV-cache slot — every request served by
+  this slot renders its lifecycle span here (docs/observability.md)."""
+  return f"serving/slot {slot}"
+
 
 @dataclasses.dataclass
 class Request:
@@ -82,6 +90,7 @@ class StepPlan:
   top_k: np.ndarray           # int32 [N]
   top_p: np.ndarray           # f32   [N]
   draft_cap: np.ndarray       # int32 [N] max speculative drafts this step
+  prefilling: np.ndarray      # bool  [N]   this step's grant is prompt work
   prefill_tokens: int         # scheduled prompt tokens this step
   decode_tokens: int          # scheduled decode tokens this step
   active_slots: int
@@ -176,6 +185,12 @@ class FCFSScheduler:
     if req.stop_token < 0 and self.default_stop_token >= 0:
       req = dataclasses.replace(req, stop_token=self.default_stop_token)
     self.pending.append(req)
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:  # args dicts are not free; skip them when off
+      tracer.instant(
+          "serving/submit", cat="serving", track="serving/requests",
+          args={"uid": str(req.uid), "prompt_tokens": int(prompt.size),
+                "max_new_tokens": int(req.max_new_tokens)})
 
   @property
   def has_work(self) -> bool:
@@ -209,6 +224,18 @@ class FCFSScheduler:
       slot = self.allocator.alloc()
       self.active[slot] = _SlotState(req, slot)
       self._admit_order.append(slot)
+      # The request's lifecycle span opens on its slot's track and stays
+      # open until _retire — every per-step prefill/decode span the
+      # engine records for this slot nests inside it, so one Perfetto
+      # track row reads as the request's complete timeline.
+      tracer = trace_lib.get_tracer()
+      if tracer.enabled:
+        tracer.begin(
+            f"request {req.uid}", cat="serving.request",
+            track=_slot_track(slot),
+            args={"uid": str(req.uid),
+                  "prompt_tokens": int(len(req.prompt)),
+                  "max_new_tokens": int(req.max_new_tokens)})
       if self.on_admit:
         self.on_admit(req.uid)
 
@@ -236,6 +263,7 @@ class FCFSScheduler:
         top_k=np.zeros((N,), np.int32),
         top_p=np.ones((N,), np.float32),
         draft_cap=np.zeros((N,), np.int32),
+        prefilling=np.zeros((N,), bool),
         prefill_tokens=0, decode_tokens=0,
         active_slots=len(self.active))
     budget = self.prefill_token_budget
@@ -260,6 +288,7 @@ class FCFSScheduler:
         chunk = req.prompt[state.prompt_pos:state.prompt_pos + grant]
         plan.tokens[slot, :grant] = chunk
         plan.num_valid[slot] = grant
+        plan.prefilling[slot] = True
         plan.prefill_tokens += grant
       else:
         plan.tokens[slot, 0] = state.generated[-1]
@@ -292,6 +321,13 @@ class FCFSScheduler:
     del self.active[slot]
     self._admit_order.remove(slot)
     self.allocator.free(slot)
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.end(
+          f"request {state.req.uid}", cat="serving.request",
+          track=_slot_track(slot),
+          args={"finish_reason": reason,
+                "new_tokens": int(len(state.generated))})
     fin = FinishedRequest(
         uid=state.req.uid,
         tokens=np.concatenate(
@@ -336,6 +372,11 @@ class FCFSScheduler:
         if state.prefilling:
           continue  # more prompt to feed; discard the sample
         state.first_token_at = now
+        tracer = trace_lib.get_tracer()
+        if tracer.enabled:
+          tracer.instant(
+              "serving/first_token", cat="serving",
+              track=_slot_track(slot), args={"uid": str(req.uid)})
         if self.on_first_token:
           self.on_first_token(req.uid)
       for j in range(int(num_committed[slot])):

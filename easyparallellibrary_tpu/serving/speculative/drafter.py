@@ -117,19 +117,22 @@ class NgramDrafter(Drafter):
     self.lookback = int(lookback)
 
   def propose(self, plan, histories):
+    from easyparallellibrary_tpu.observability import trace as trace_lib
     N = plan.tokens.shape[0]
     toks = np.zeros((N, self.k), np.int32)
     counts = np.zeros((N,), np.int32)
-    for slot, hist in histories.items():
-      cap = int(plan.draft_cap[slot])
-      if cap <= 0:
-        continue
-      if self.lookback:
-        hist = hist[-self.lookback:]
-      cont = ngram_propose(hist, min(cap, self.k), self.ngram_max,
-                           self.ngram_min)
-      counts[slot] = len(cont)
-      toks[slot, :len(cont)] = cont
+    with trace_lib.get_tracer().span("ngram_propose", cat="serving",
+                                     track="serving"):
+      for slot, hist in histories.items():
+        cap = int(plan.draft_cap[slot])
+        if cap <= 0:
+          continue
+        if self.lookback:
+          hist = hist[-self.lookback:]
+        cont = ngram_propose(hist, min(cap, self.k), self.ngram_max,
+                             self.ngram_min)
+        counts[slot] = len(cont)
+        toks[slot, :len(cont)] = cont
     return toks, counts
 
 
@@ -224,13 +227,17 @@ class DraftModelDrafter(Drafter):
     return jax.jit(draft, donate_argnums=(1,))
 
   def propose(self, plan, histories):
+    from easyparallellibrary_tpu.observability import trace as trace_lib
     if self._fn is None:
       raise RuntimeError("DraftModelDrafter.propose before bind(): the "
                          "engine binds drafters in its constructor")
-    toks, self._kv = self._fn(self.params, self._kv, self._cursors,
-                              plan.tokens, plan.num_valid, plan.reset)
+    with trace_lib.get_tracer().span("draft_model_forward", cat="serving",
+                                     track="serving"):
+      toks, self._kv = self._fn(self.params, self._kv, self._cursors,
+                                plan.tokens, plan.num_valid, plan.reset)
+      toks = np.asarray(toks)
     counts = np.minimum(plan.draft_cap, self.k).astype(np.int32)
-    return np.asarray(toks), counts
+    return toks, counts
 
   def observe_commit(self, new_cursors):
     # Cursor values are "committed tokens resident in cache" — identical

@@ -29,6 +29,34 @@ import time
 from typing import Any, Dict, List, Tuple
 
 import jax
+import numpy as np
+
+
+def _coerce_metric(v: Any) -> Any:
+  """Flush-time coercion of one buffered metric value.
+
+  Scalars (python numbers, 0-d / 1-element device arrays) become
+  ``float``.  Multi-element arrays fail ``float()`` — those get a
+  compact ``{shape, dtype, mean}`` summary instead of a multi-kilobyte
+  ``str()`` repr dumped into the JSONL (a [1024, 1024] grad-norm debug
+  tensor is one line of metadata, not a megabyte of digits).  Anything
+  else (strings, arbitrary objects) still falls back to ``str``.
+  """
+  try:
+    return float(v)
+  except (TypeError, ValueError):
+    pass
+  if getattr(v, "shape", None) is not None and \
+      getattr(v, "dtype", None) is not None:
+    try:
+      host = np.asarray(v)
+      mean = float(np.mean(host.astype(np.float64))) \
+          if host.size else None
+    except (TypeError, ValueError):  # non-numeric dtype
+      mean = None
+    return {"shape": [int(d) for d in v.shape], "dtype": str(v.dtype),
+            "mean": mean}
+  return str(v)
 
 
 class _LeaderSink:
@@ -54,12 +82,7 @@ class _LeaderSink:
     if not self._active:
       return
     for step, wall, metrics in self._buf:
-      record: Dict[str, Any] = {}
-      for k, v in metrics.items():
-        try:
-          record[k] = float(v)
-        except (TypeError, ValueError):
-          record[k] = str(v)
+      record = {k: _coerce_metric(v) for k, v in metrics.items()}
       self._emit(step, wall, record)
     self._buf = []
     self._flush_io()
