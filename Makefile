@@ -15,6 +15,11 @@ bench:
 chaos:
 	python -m pytest tests/test_resilience.py -q
 
+# Serving chaos: NaN steps, hung steps, flaky drafters, Poisson overload
+# against the resilient engine (docs/robustness.md "Serving resilience").
+chaos-serve:
+	python -m pytest tests/test_serving_resilience.py -q
+
 # Continuous batching vs static-batch generate() under Poisson arrivals
 # (benchmarks/decode_throughput.py -> BENCH_EVIDENCE.json; docs/serving.md).
 serve-bench:
@@ -25,6 +30,12 @@ serve-bench:
 spec-bench:
 	python benchmarks/speculative_decode.py
 
+# Bounded admission queue + degradation ladder vs an unprotected engine
+# under a Poisson overload burst (benchmarks/serving_overload.py ->
+# BENCH_EVIDENCE.json; docs/robustness.md "Serving resilience").
+overload-bench:
+	python benchmarks/serving_overload.py
+
 # Tiny traced fit() + serving episode on the CPU mesh -> trace_demo.json
 # (schema-validated; load at ui.perfetto.dev; docs/observability.md).
 trace-demo:
@@ -33,4 +44,4 @@ trace-demo:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test bench chaos serve-bench spec-bench trace-demo clean
+.PHONY: all build test bench chaos chaos-serve serve-bench spec-bench overload-bench trace-demo clean

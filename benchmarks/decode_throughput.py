@@ -56,6 +56,7 @@ from easyparallellibrary_tpu.profiler.serving import (  # noqa: E402
     ServingStats, percentile)
 from easyparallellibrary_tpu.serving import (  # noqa: E402
     ContinuousBatchingEngine, Request)
+from easyparallellibrary_tpu.testing.chaos import poisson_trace  # noqa: E402
 from easyparallellibrary_tpu.utils import bench_evidence  # noqa: E402
 
 METRIC = "decode_throughput"
@@ -64,10 +65,11 @@ METRIC = "decode_throughput"
 def make_trace(num_requests: int, arrival_rate_hz: float, plen: int,
                short_new: int, long_new: int, long_frac: float,
                vocab: int, seed: int = 0):
-  """Seeded Poisson arrival trace with a skewed decode-length mix."""
+  """Seeded Poisson arrival trace with a skewed decode-length mix
+  (arrival model shared with testing.chaos.poisson_trace)."""
   r = np.random.RandomState(seed)
-  gaps = r.exponential(1.0 / arrival_rate_hz, size=num_requests)
-  arrivals = np.cumsum(gaps)
+  arrivals = poisson_trace(arrival_rate_hz, num_requests, rng=r,
+                           first_at_zero=False)
   prompts = r.randint(0, vocab, (num_requests, plen)).astype(np.int32)
   max_new = np.where(r.rand(num_requests) < long_frac,
                      long_new, short_new).astype(int)

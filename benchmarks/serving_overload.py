@@ -1,0 +1,174 @@
+"""Overload episode: admission control & shedding vs an unprotected queue.
+
+Serves one seeded Poisson burst arriving well above service capacity
+two ways on the active backend (virtual clock advanced by MEASURED
+step wall time, exactly like benchmarks/decode_throughput.py):
+
+  * **unprotected** — the plain engine: every request is accepted, the
+    queue grows without bound for the duration of the burst, and
+    tail time-to-first-token blows up with queue position (the failure
+    mode ``serving.resilience.*`` exists to remove);
+  * **resilient** — the same engine under a bounded admission queue
+    (``queue_limit``) with the degradation ladder live: excess arrivals
+    are shed AT SUBMIT (the client learns now), speculation/budget
+    degrade under pressure, and every ACCEPTED request keeps a bounded
+    queue wait.
+
+The record (``BENCH_EVIDENCE.json`` via ``utils.bench_evidence``)
+carries both sides' TTFT p50/p99 and queue peaks, the resilient side's
+shed fraction and ladder transitions, and the headline
+``ttft_p99_ratio`` (unprotected / resilient — how much first-token
+tail the bounded queue removed for the requests it chose to serve).
+Served-request output streams are bit-identical on both sides (the
+exactness contract is not a knob), so the comparison is pure admission
+policy.
+
+Run: ``python benchmarks/serving_overload.py`` (or ``make overload-bench``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+  os.environ["XLA_FLAGS"] = (
+      _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+  jax.config.update("jax_platforms", "cpu")
+
+import easyparallellibrary_tpu as epl  # noqa: E402
+from easyparallellibrary_tpu.models import GPT, GPTConfig  # noqa: E402
+from easyparallellibrary_tpu.profiler.serving import percentile  # noqa: E402
+from easyparallellibrary_tpu.serving import (  # noqa: E402
+    ContinuousBatchingEngine, Request)
+from easyparallellibrary_tpu.testing.chaos import poisson_trace  # noqa: E402
+
+METRIC = "serving_overload"
+
+
+def _episode(model, params, prompts, max_new, arrivals, num_slots,
+             chunk, queue_limit):
+  """One overload episode on a virtual clock; returns the policy record."""
+  config = None
+  if queue_limit:
+    config = epl.Config({"serving": {"resilience": {
+        "enabled": True, "queue_limit": queue_limit,
+        "degrade_queue_frac": 0.25}}})
+  eng = ContinuousBatchingEngine(model, params, num_slots=num_slots,
+                                 prefill_chunk=chunk, config=config)
+  eng.submit(Request(uid="warm", prompt=prompts[0], max_new_tokens=2))
+  eng.run()  # compile outside the clock
+  n = len(arrivals)
+  clock, busy, nxt = 0.0, 0.0, 0
+  submit_at, first_at = {}, {}
+  peak_queue = 0
+  # The hook fires mid-step, but on the virtual clock a token only
+  # exists once its step has been paid for — buffer the uids and stamp
+  # them AFTER the clock advances past the step.
+  first_this_step = []
+  eng.scheduler.on_first_token.append(first_this_step.append)
+  while nxt < n or eng.has_work:
+    while nxt < n and arrivals[nxt] <= clock:
+      submit_at[nxt] = clock
+      eng.submit(Request(uid=nxt, prompt=prompts[nxt],
+                         max_new_tokens=int(max_new[nxt])))
+      nxt += 1
+    peak_queue = max(peak_queue, eng.scheduler.queue_depth)
+    if not eng.has_work:
+      clock = arrivals[nxt]
+      continue
+    t0 = time.perf_counter()
+    eng.step()
+    dt = time.perf_counter() - t0
+    clock += dt
+    busy += dt
+    for uid in first_this_step:
+      first_at.setdefault(uid, clock)
+    first_this_step.clear()
+  shed = sorted(u for u, f in eng.finished.items()
+                if u != "warm" and f.finish_reason == "shed")
+  shed_set = set(shed)
+  served = [i for i in range(n) if i not in shed_set]
+  ttfts = [first_at[i] - submit_at[i] for i in served if i in first_at]
+  useful = sum(eng.finished[i].new_tokens for i in served)
+  rec = {
+      "requests": n,
+      "served": len(served),
+      "shed": len(shed),
+      "shed_frac": len(shed) / n,
+      "peak_queue_depth": int(peak_queue),
+      "ttft_p50_s": percentile(ttfts, 50),
+      "ttft_p99_s": percentile(ttfts, 99),
+      "goodput_tokens_per_s": useful / max(busy, 1e-9),
+      "makespan_s": float(clock),
+  }
+  if eng._admission is not None:
+    rec["ladder_transitions"] = int(eng._admission.transitions)
+    rec["degraded_level_final"] = int(eng._admission.level)
+  return rec
+
+
+def run(num_requests: int = 48, overload_factor: float = 3.0,
+        num_slots: int = 4, chunk: int = 4, plen: int = 6,
+        max_new: int = 8, queue_limit: int = 8):
+  epl.init()
+  cfg = GPTConfig(vocab_size=256, num_layers=2, num_heads=8, d_model=128,
+                  d_ff=512, max_seq_len=64, dtype=jnp.float32)
+  model = GPT(cfg)
+  params = model.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, plen), jnp.int32))["params"]
+  r = np.random.RandomState(0)
+  prompts = r.randint(0, cfg.vocab_size,
+                      (num_requests, plen)).astype(np.int32)
+  lens = np.full((num_requests,), max_new, int)
+  # Calibrate the arrival rate to `overload_factor` x measured service
+  # capacity, so "overload" is true with respect to this box, not a guess.
+  probe = _episode(model, params, prompts[:8], lens[:8],
+                   np.zeros(8), num_slots, chunk, queue_limit=0)
+  cap_rps = probe["served"] / probe["makespan_s"]
+  rate = overload_factor * cap_rps
+  arrivals = poisson_trace(rate, num_requests, seed=1)
+  unprotected = _episode(model, params, prompts, lens, arrivals,
+                         num_slots, chunk, queue_limit=0)
+  resilient = _episode(model, params, prompts, lens, arrivals,
+                       num_slots, chunk, queue_limit=queue_limit)
+  record = {
+      "metric": METRIC,
+      "backend": jax.devices()[0].platform,
+      "device_kind": jax.devices()[0].device_kind,
+      "config": {
+          "model": {"d_model": cfg.d_model, "num_layers": cfg.num_layers,
+                    "vocab": cfg.vocab_size},
+          "num_requests": num_requests,
+          "overload_factor": overload_factor,
+          "measured_capacity_rps": cap_rps,
+          "arrival_rate_hz": float(rate),
+          "num_slots": num_slots, "prefill_chunk": chunk,
+          "plen": plen, "max_new": max_new, "queue_limit": queue_limit,
+      },
+      "unprotected": unprotected,
+      "resilient": resilient,
+      "ttft_p99_ratio":
+          unprotected["ttft_p99_s"] / max(resilient["ttft_p99_s"], 1e-9),
+  }
+  from easyparallellibrary_tpu.utils import bench_evidence
+  bench_evidence.append_record(record)
+  print(json.dumps(record))
+  return record
+
+
+if __name__ == "__main__":
+  run()

@@ -228,6 +228,14 @@ class Cluster:
       self.build_mesh()
     return self._mesh
 
+  @property
+  def built_mesh(self) -> Optional[Mesh]:
+    """The mesh if :meth:`build_mesh` has run, else None — the
+    observe-without-forcing accessor (``mesh`` force-builds) for
+    components that only want to ADOPT an existing cluster layout,
+    e.g. the serving engine's ambient-mesh resolution."""
+    return self._mesh
+
   def axis_size(self, axis: str) -> int:
     return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))[axis]
 

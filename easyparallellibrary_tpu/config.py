@@ -410,6 +410,13 @@ class ServingConfig(_Category):
       # update; steady-state device allocation = one cache).  Turn off
       # only for debugging (keeps every step's input cache alive).
       "donate_cache": True,
+      # Retention bound on resolved-request records (engine.finished
+      # and the stats' finished per-request traces): keep only the most
+      # recent N, evicting oldest-first.  0 = keep all (fine for
+      # episodic runs; a long-running server otherwise grows host
+      # memory linearly with requests served).  run()'s return value is
+      # unaffected — it collects each call's retirements directly.
+      "finished_limit": 0,
       # --- speculative decoding (serving/speculative/, docs/serving.md).
       # Draft k tokens per decode slot and verify them in the SAME fused
       # step (the drafts ride chunk positions plain decode wastes), so
@@ -426,11 +433,45 @@ class ServingConfig(_Category):
       # Longest/shortest history suffix the n-gram drafter matches.
       "speculative.ngram_max": 4,
       "speculative.ngram_min": 1,
+      # --- serving resilience (serving/resilience.py,
+      # docs/robustness.md "Serving resilience").  Master switch: off
+      # keeps the engine's pre-resilience fused step and host loop
+      # byte-identical.  On, the fused step gains an in-jit finiteness
+      # verdict (no extra host sync — it rides the step's own token
+      # fetch) and the host loop gains admission control, deadlines and
+      # bad-step recovery.
+      "resilience.enabled": False,
+      # Bounded admission queue: submits beyond this many waiting
+      # requests are shed (finish_reason "shed").  0 = unbounded (no
+      # shedding, no queue-driven degradation).
+      "resilience.queue_limit": 0,
+      # Inter-token-latency SLO: a measured ITL (EWMA of decode-step
+      # time, profiler/serving.py) above this forces at least the
+      # spec_off degradation level.  0 = off.
+      "resilience.itl_slo_s": 0.0,
+      # Queue-depth fraction of queue_limit that enters degradation
+      # level 1 (spec_off); level 2 enters halfway between it and full,
+      # level 3 (shed) at full.  De-escalation at half the entry
+      # threshold (hysteresis).
+      "resilience.degrade_queue_frac": 0.5,
+      # Bad-step recovery: in-place exact retries per slot before the
+      # request is quarantined (requeued with its committed prefix),
+      # and requeues per request before it is failed.
+      "resilience.max_step_retries": 1,
+      "resilience.max_requeues": 1,
+      # Hung-step watchdog: log + trace when one fused step (dispatch +
+      # result fetch) exceeds this wall-clock deadline (0 = off).  The
+      # step is not interrupted — observability, like the fit() one.
+      "resilience.step_timeout_s": 0.0,
   }
 
   @property
   def speculative(self) -> _SubGroup:
     return _SubGroup(self, "speculative")
+
+  @property
+  def resilience(self) -> _SubGroup:
+    return _SubGroup(self, "resilience")
 
 
 class ObservabilityConfig(_Category):
@@ -610,6 +651,9 @@ class Config:
     if self.serving.stop_token < -1:
       raise ValueError(f"serving.stop_token must be >= -1; "
                        f"got {self.serving.stop_token}")
+    if self.serving.finished_limit < 0:
+      raise ValueError(f"serving.finished_limit must be >= 0 (0 = keep "
+                       f"all); got {self.serving.finished_limit}")
     spec = self.serving.speculative
     if spec.k < 1:
       raise ValueError(
@@ -633,6 +677,24 @@ class Config:
           f">= k + 1 (the fused step carries each decode slot's last "
           f"committed token plus its k drafts in one chunk); got "
           f"prefill_chunk {self.serving.prefill_chunk}")
+    res = self.serving.resilience
+    if res.queue_limit < 0:
+      raise ValueError(f"serving.resilience.queue_limit must be >= 0 "
+                       f"(0 = unbounded); got {res.queue_limit}")
+    if res.itl_slo_s < 0:
+      raise ValueError(f"serving.resilience.itl_slo_s must be >= 0 "
+                       f"(0 = off); got {res.itl_slo_s}")
+    if not 0.0 < res.degrade_queue_frac <= 1.0:
+      raise ValueError(
+          f"serving.resilience.degrade_queue_frac must be in (0, 1]; "
+          f"got {res.degrade_queue_frac}")
+    if res.max_step_retries < 0 or res.max_requeues < 0:
+      raise ValueError(
+          "serving.resilience.max_step_retries and max_requeues must be "
+          f">= 0; got {res.max_step_retries}, {res.max_requeues}")
+    if res.step_timeout_s < 0:
+      raise ValueError(f"serving.resilience.step_timeout_s must be >= 0 "
+                       f"(0 = off); got {res.step_timeout_s}")
 
   def to_dict(self) -> Dict[str, Dict[str, Any]]:
     return {c._name: getattr(self, c._name).to_dict()

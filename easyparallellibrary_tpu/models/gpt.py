@@ -126,6 +126,18 @@ def slot_cache_attend(q, k, v, cached_k, cached_v, cursors, dtype):
   reused slot only ever attends to positions its own tokens have
   written.
 
+  FINITENESS INVARIANT: masking zeroes a stale position's softmax
+  probability, but the probability-weighted V sum still contracts over
+  every cache position and ``0 * NaN = NaN`` — so callers must never
+  leave NON-FINITE values in cache rows they will not overwrite before
+  the next read.  Garbage-but-finite stale rows are fine (their exact-0
+  probability annihilates them).  The one producer of non-finite rows
+  is a poisoned device step under serving resilience: the engine zeroes
+  the bad step's writes before the slot is read again — a retried
+  slot's rows above its committed cursor, a quarantined slot whole
+  (engine._sanitize_slots) — so the invariant holds without taxing
+  this hot path.
+
   Returns ``(out [B, C, H, hd], new_cached_k, new_cached_v)``.
   """
   B, C, H, hd = q.shape

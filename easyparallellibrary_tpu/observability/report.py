@@ -80,20 +80,37 @@ def request_timelines(events: List[Dict[str, Any]]
                       ) -> List[Dict[str, Any]]:
   """Per-request lifecycle rollup from the serving instrumentation:
   request spans (cat ``serving.request``), the prefill/decode/speculate
-  chunk spans nested in them, and the submit/first_token instants."""
+  chunk spans nested in them, and the submit/first_token instants —
+  plus the resilience events (docs/robustness.md "Serving resilience"):
+  per-uid requeue counts, and rows for requests that never reached a
+  slot (shed at submit, expired or cancelled in the queue), whose whole
+  story is an instant."""
   spans, _ = pair_spans(events)
   submits: Dict[str, float] = {}
   first_tokens: Dict[str, float] = {}
+  requeues: Dict[str, int] = {}
+  # Requests resolved without ever holding a slot: uid -> (ts, reason).
+  unadmitted: Dict[str, Tuple[float, str]] = {}
   for ev in events:
     if ev.get("ph") != "i":
       continue
     uid = (ev.get("args") or {}).get("uid")
     if uid is None:
       continue
-    if ev.get("name") == "serving/submit":
-      submits[str(uid)] = ev["ts"]
-    elif ev.get("name") == "serving/first_token":
-      first_tokens[str(uid)] = ev["ts"]
+    uid = str(uid)
+    name = ev.get("name")
+    if name == "serving/submit":
+      submits[uid] = ev["ts"]
+    elif name == "serving/first_token":
+      first_tokens[uid] = ev["ts"]
+    elif name == "serving/requeue":
+      requeues[uid] = requeues.get(uid, 0) + 1
+    elif name == "serving/shed":
+      unadmitted[uid] = (ev["ts"], "shed")
+    elif name in ("serving/deadline", "serving/cancelled"):
+      # Emitted only for queue-side retirement (args.where == "queue");
+      # slot-side expiry/cancellation ends the request span instead.
+      unadmitted[uid] = (ev["ts"], name.split("/", 1)[1])
   requests = []
   for req in (s for s in spans if s["cat"] == "serving.request"):
     uid = str(req["args"].get("uid", req["name"]))
@@ -124,6 +141,27 @@ def request_timelines(events: List[Dict[str, Any]]
         "drafted": drafted, "accepted": accepted,
         "new_tokens": req["args"].get("new_tokens"),
         "finish_reason": req["args"].get("finish_reason"),
+        "requeues": requeues.get(uid, 0),
+    })
+  # A requeued request's queue-side resolution (expiry/cancel) — or a
+  # shed — is an instant, not a span end; requests that DID end in a
+  # slot already carry their final reason above.
+  resolved_in_slot = {r["uid"] for r in requests
+                      if r["finish_reason"] not in (None, "requeued")}
+  for uid, (ts, reason) in unadmitted.items():
+    if uid in resolved_in_slot:
+      continue
+    submit = submits.get(uid)
+    requests.append({
+        "uid": uid,
+        "queue_wait_us": (ts - submit) if submit is not None else None,
+        "admitted_ts_us": ts,
+        "total_us": None, "ttft_us": None,
+        "prefill_us": 0.0, "prefill_chunks": 0,
+        "decode_steps": 0, "decode_us": 0.0,
+        "drafted": 0, "accepted": 0,
+        "new_tokens": None, "finish_reason": reason,
+        "requeues": requeues.get(uid, 0),
     })
   requests.sort(key=lambda r: r["admitted_ts_us"])
   return requests
@@ -161,14 +199,15 @@ def format_report(events: List[Dict[str, Any]]) -> str:
     lines.append("")
     lines.append(f"{'request':<12}{'wait':>9}{'ttft':>10}{'prefill':>10}"
                  f"{'chunks':>7}{'decode':>10}{'steps':>6}{'drafted':>8}"
-                 f"{'accepted':>9}{'total':>10}  finish")
+                 f"{'accepted':>9}{'rq':>4}{'total':>10}  finish")
     for r in requests:
       lines.append(
           f"{r['uid']:<12}{_fmt_us(r['queue_wait_us']):>9}"
           f"{_fmt_us(r['ttft_us']):>10}{_fmt_us(r['prefill_us']):>10}"
           f"{r['prefill_chunks']:>7}{_fmt_us(r['decode_us']):>10}"
           f"{r['decode_steps']:>6}{r['drafted']:>8}{r['accepted']:>9}"
-          f"{_fmt_us(r['total_us']):>10}  {r['finish_reason'] or '-'}")
+          f"{r['requeues']:>4}{_fmt_us(r['total_us']):>10}"
+          f"  {r['finish_reason'] or '-'}")
   counters = sorted({e["name"] for e in events if e.get("ph") == "C"})
   if counters:
     lines.append("")

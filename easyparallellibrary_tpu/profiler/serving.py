@@ -21,6 +21,14 @@ standard serving quartet:
   run — or on a non-speculative engine — that window is legitimately
   empty or a single sample; every rollup degrades gracefully to 0.0 /
   the lone sample rather than raising.
+* **resilience** — shed / expired / cancelled / failed request counts,
+  bad device steps and in-place retries, requeues, degradation-ladder
+  transitions and watchdog timeouts (docs/robustness.md "Serving
+  resilience"), plus ``itl_ewma_s``: an exponentially weighted moving
+  average of decode-step time — the live inter-token-latency estimate
+  the admission controller compares against ``itl_slo_s`` (per-request
+  ITL percentiles only exist after requests FINISH; overload needs a
+  signal mid-flight).
 
 The engine feeds these via the ``note_*`` hooks; ``summary()`` rolls
 them up for logs / ``MetricsWriter`` / BENCH_EVIDENCE records.  Host
@@ -65,17 +73,25 @@ class ServingStats:
 
   ``clock`` is injectable for deterministic tests.  All ``note_*`` hooks
   are cheap (dict insert / float math) and safe to call from the
-  engine's host loop.
+  engine's host loop.  ``finished_limit`` bounds how many FINISHED
+  per-request traces are retained (oldest evicted first; latency
+  percentiles become a sliding window over the retained tail) — 0
+  keeps all, which on a long-running server grows host memory linearly
+  with requests served.  In-flight traces are never evicted.
   """
 
-  def __init__(self, clock=time.monotonic):
+  def __init__(self, clock=time.monotonic, finished_limit: int = 0):
     self._clock = clock
+    self.finished_limit = finished_limit
     self.reset()
 
   def reset(self):
     """Zero every counter and trace — call after an engine warmup so the
     compile step never pollutes throughput/latency rollups."""
     self._req: Dict[Any, _RequestTrace] = {}
+    # Insertion-ordered set (dict keys) of windowed finished uids:
+    # pop-then-insert refreshes a reused uid's position in O(1).
+    self._finished_order: Dict[Any, None] = {}
     self.steps = 0
     self.busy_time_s = 0.0
     self.prefill_tokens = 0
@@ -89,6 +105,24 @@ class ServingStats:
     # legitimately empty early in a run (all-prefill steps) or on a
     # non-speculative engine.
     self._accepted_per_step: List[float] = []
+    # Resilience counters (all stay 0 on a non-resilient engine).
+    self.shed_requests = 0
+    self.requeues = 0
+    self.bad_steps = 0
+    self.step_retries = 0
+    self.degraded_transitions = 0
+    self.degraded_level = 0
+    self.watchdog_timeouts = 0
+    self.finish_reasons: Dict[str, int] = {}
+    # Live ITL estimate: EWMA of decode-step wall time (module
+    # docstring).  0.0 until the SECOND decoding step — the first
+    # decode-step sample can carry one-time XLA compile work (a draft
+    # model's first roll, the resilient sanitize program's first bad
+    # step), seconds against a millisecond SLO; seeding the EWMA with
+    # it would floor the degradation ladder at spec_off for dozens of
+    # steps on a healthy engine, so that sample is discarded.
+    self.itl_ewma_s = 0.0
+    self._itl_primed = False
 
   # ------------------------------------------------------------ lifecycle
 
@@ -103,12 +137,51 @@ class ServingStats:
     tr = self._req.setdefault(uid, _RequestTrace(self._clock()))
     tr.first_token_at = self._clock()
 
-  def note_finished(self, uid: Any, new_tokens: int):
+  def note_finished(self, uid: Any, new_tokens: int,
+                    finish_reason: Optional[str] = None):
     tr = self._req.setdefault(uid, _RequestTrace(self._clock()))
     tr.finished_at = self._clock()
     tr.new_tokens = int(new_tokens)
     self.finished_requests += 1
     self.generated_tokens += int(new_tokens)
+    if finish_reason is not None:
+      self.finish_reasons[finish_reason] = (
+          self.finish_reasons.get(finish_reason, 0) + 1)
+    if self.finished_limit > 0:
+      # Aggregate counters above keep the full history; only the
+      # per-request traces (latency percentile inputs) are windowed.
+      # pop-then-insert refreshes a reused uid's position (a stale
+      # entry would otherwise make a later eviction a no-op and
+      # transiently shrink the retained-trace window below the limit).
+      self._finished_order.pop(uid, None)
+      self._finished_order[uid] = None
+      while len(self._finished_order) > self.finished_limit:
+        oldest = next(iter(self._finished_order))
+        del self._finished_order[oldest]
+        self._req.pop(oldest, None)
+
+  # ----------------------------------------------------------- resilience
+
+  def note_shed(self, uid: Any):
+    """Rejected at submit (never enters the request-trace map: a shed
+    request has no lifecycle to time)."""
+    self.shed_requests += 1
+    self.finish_reasons["shed"] = self.finish_reasons.get("shed", 0) + 1
+
+  def sync_bad_step_counters(self, counters: Dict[str, int]):
+    """Adopt the engine's BadStepPolicy counters wholesale (single
+    source of truth — maintaining a mirrored increment per event here
+    would inevitably drift from the policy's own accounting)."""
+    self.bad_steps = int(counters["bad_steps"])
+    self.step_retries = int(counters["step_retries"])
+    self.requeues = int(counters["requeues"])
+
+  def note_degraded(self, level: int):
+    self.degraded_transitions += 1
+    self.degraded_level = int(level)
+
+  def note_watchdog_timeout(self):
+    self.watchdog_timeouts += 1
 
   # ----------------------------------------------------------------- step
 
@@ -121,6 +194,22 @@ class ServingStats:
     self.prefill_tokens += prefill_tokens
     self.decode_tokens += decode_tokens
     self._occupancy_sum += active_slots / max(num_slots, 1)
+    if decode_tokens > 0:
+      # Live EXPERIENCED-ITL proxy: a decoding request waits the whole
+      # step (prefill share included — mixed steps genuinely delay its
+      # next token; a prefill-only step says nothing and is skipped).
+      # A speculative step hands each decoding request ~(decode+
+      # accepted)/decode tokens at once, so the per-token gap is the
+      # step time scaled down by that factor — without it one K+1-token
+      # step would read as one token gap and overstate ITL by up to
+      # (K+1)x, pinning the degradation ladder's SLO signal high.
+      committed = decode_tokens + max(int(accepted_tokens), 0)
+      sample = step_time_s * decode_tokens / committed
+      if not self._itl_primed:
+        self._itl_primed = True   # compile-polluted; see itl_ewma_s init
+      else:
+        self.itl_ewma_s = (sample if self.itl_ewma_s == 0.0
+                           else 0.8 * self.itl_ewma_s + 0.2 * sample)
     if drafted_tokens > 0:
       self.drafted_tokens += int(drafted_tokens)
       self.accepted_tokens += int(accepted_tokens)
@@ -177,4 +266,17 @@ class ServingStats:
         "accepted_per_step_mean": (sum(acc) / len(acc)) if acc else 0.0,
         "accepted_per_step_p50": percentile(acc, 50),
         "accepted_per_step_p99": percentile(acc, 99),
+        # Resilience (all 0.0 on a non-resilient engine; docs/
+        # robustness.md "Serving resilience").
+        "shed": float(self.shed_requests),
+        "deadline_expired": float(self.finish_reasons.get("deadline", 0)),
+        "cancelled": float(self.finish_reasons.get("cancelled", 0)),
+        "failed": float(self.finish_reasons.get("failed", 0)),
+        "bad_steps": float(self.bad_steps),
+        "step_retries": float(self.step_retries),
+        "requeues": float(self.requeues),
+        "degraded": float(self.degraded_transitions),
+        "degraded_level": float(self.degraded_level),
+        "watchdog_timeouts": float(self.watchdog_timeouts),
+        "itl_ewma_s": float(self.itl_ewma_s),
     }

@@ -200,9 +200,14 @@ class StepWatchdog:
   """
 
   def __init__(self, timeout_s: float,
-               on_timeout: Optional[Callable[[int], None]] = None):
+               on_timeout: Optional[Callable[[int], None]] = None,
+               knob: str = "resilience.step_timeout_s"):
     self.timeout_s = timeout_s
     self.on_timeout = on_timeout
+    # Which config knob set this deadline — named in the timeout log so
+    # a serving watchdog (serving.resilience.step_timeout_s) reads
+    # differently from the training one.
+    self.knob = knob
     self.timeouts_fired = 0
     self._cond = threading.Condition()
     self._deadline: Optional[float] = None
@@ -263,10 +268,10 @@ class StepWatchdog:
       devices = -1
     log.warning(
         "watchdog: step %d exceeded the %.1fs deadline "
-        "(resilience.step_timeout_s); %d device(s) visible. Likely "
+        "(%s); %d device(s) visible. Likely "
         "causes: stalled input pipeline, XLA recompile, or a wedged "
         "collective. Dumping thread stacks to stderr.",
-        step, self.timeout_s, devices)
+        step, self.timeout_s, self.knob, devices)
     try:
       import faulthandler
       faulthandler.dump_traceback(all_threads=True)

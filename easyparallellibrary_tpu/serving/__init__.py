@@ -4,16 +4,22 @@ The fourth runtime mode (train / eval / generate / serve): a slot-based
 preallocated KV cache (:mod:`kv_cache`), a host-side FCFS scheduler with
 chunked-prefill admission (:mod:`scheduler`), a single-jitted-step
 engine that fuses prefill and decode so requests join and leave the
-batch every iteration (:mod:`engine`), and speculative decoding —
-drafters plus batched verification with per-slot accept/rollback riding
-that same step (:mod:`speculative`).  See docs/serving.md.
+batch every iteration (:mod:`engine`), speculative decoding — drafters
+plus batched verification with per-slot accept/rollback riding that
+same step (:mod:`speculative`) — and a resilience layer: admission
+control with overload shedding, per-request deadlines/cancellation,
+and bad-step retry/quarantine (:mod:`resilience`).  See
+docs/serving.md and docs/robustness.md.
 """
 
 from easyparallellibrary_tpu.serving._capabilities import (
-    check_draft_compatible, check_servable,
+    FINISH_REASONS, PRIORITIES, check_draft_compatible, check_servable,
 )
 from easyparallellibrary_tpu.serving.engine import (
     ContinuousBatchingEngine, filtered_logits, sample_token_slots,
+)
+from easyparallellibrary_tpu.serving.resilience import (
+    DEGRADE_LEVELS, AdmissionController, BadStepPolicy,
 )
 from easyparallellibrary_tpu.serving.kv_cache import (
     SlotAllocator, allocate_kv_cache, cache_bytes, cache_length,
@@ -33,6 +39,8 @@ __all__ = [
     "kv_cache_shardings",
     "FCFSScheduler", "FinishedRequest", "Request", "StepPlan",
     "check_draft_compatible", "check_servable",
+    "AdmissionController", "BadStepPolicy", "DEGRADE_LEVELS",
+    "FINISH_REASONS", "PRIORITIES",
     "Drafter", "DraftModelDrafter", "NgramDrafter", "ngram_propose",
     "verify_tokens",
 ]

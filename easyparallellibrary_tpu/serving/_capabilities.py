@@ -22,6 +22,47 @@ ROADMAP_MOE_SERVING = (
 ROADMAP_DRAFT_DISTILL = (
     "training a matched drafter is a ROADMAP follow-up ('draft-model "
     "distillation'; docs/serving.md 'Speculative decoding')")
+ROADMAP_PREEMPTION = (
+    "preempting a RUNNING decode (paging its KV out for a latency-class "
+    "arrival) needs the paged cache — ROADMAP open item 1; today "
+    "priority only reorders ADMISSION")
+
+# Finish-reason glossary (docs/robustness.md "Serving resilience"):
+#   length      — max_new_tokens reached
+#   stop_token  — the request's stop token was generated
+#   deadline    — Request.deadline_s / ttft_budget_s expired
+#   cancelled   — client cancellation (scheduler/engine .cancel(uid))
+#   shed        — rejected at submit by admission control (overload)
+#   failed      — quarantined more than serving.resilience.max_requeues
+#                 times (persistent bad steps implicating this request)
+FINISH_REASONS = ("length", "stop_token", "deadline", "cancelled",
+                  "shed", "failed")
+
+# Admission classes: "latency" jumps the FCFS queue, "throughput" rides
+# it.  (True preemption of running requests: ROADMAP_PREEMPTION.)
+PRIORITIES = ("latency", "throughput")
+
+
+def check_request_fields(req) -> None:
+  """Validate a Request's lifecycle-control fields at submit time, so a
+  typo'd priority class or negative deadline fails loudly instead of
+  silently never expiring."""
+  if req.priority not in PRIORITIES:
+    raise ValueError(
+        f"request priority must be one of {PRIORITIES}; got "
+        f"{req.priority!r} — {ROADMAP_PREEMPTION}")
+  if req.deadline_s < 0:
+    raise ValueError(f"deadline_s must be >= 0 (0 = none): "
+                     f"{req.deadline_s}")
+  if req.ttft_budget_s < 0:
+    raise ValueError(f"ttft_budget_s must be >= 0 (0 = none): "
+                     f"{req.ttft_budget_s}")
+  if (req.deadline_s > 0 and req.ttft_budget_s > 0
+      and req.ttft_budget_s > req.deadline_s):
+    raise ValueError(
+        f"ttft_budget_s {req.ttft_budget_s} exceeds deadline_s "
+        f"{req.deadline_s}: the first token can never beat a budget "
+        f"that outlives the whole request")
 
 
 def check_servable(cfg, role: str = "the serving engine") -> None:

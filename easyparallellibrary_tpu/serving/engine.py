@@ -16,11 +16,13 @@ allocation is exactly one cache.
 
 Division of labor: :class:`FCFSScheduler` (scheduler.py) owns all
 host-side variability (admission, budgets, retirement, RNG streams);
-this module owns the device program and its placement.  Sampling runs
-per-slot inside the step (:func:`sample_token_slots` — the traced-
-parameter twin of ``sample_logits``) with per-request keys folded by
-token index, so a request's sample stream is independent of which slot
-or iteration serves it.
+:mod:`serving.resilience` owns fault/overload POLICY (admission
+control, the degradation ladder, retry-vs-quarantine); this module owns
+the device program, its placement, and the mechanics that policy drives.
+Sampling runs per-slot inside the step (:func:`sample_token_slots` —
+the traced-parameter twin of ``sample_logits``) with per-request keys
+folded by token index, so a request's sample stream is independent of
+which slot or iteration serves it.
 
 Speculative decoding (serving/speculative/) rides the same fused step:
 a drafter fills each decode slot's unused chunk positions with ``k``
@@ -30,18 +32,34 @@ accept/rollback commits the accepted prefix plus one correction/bonus
 token, rolling cursors back to the last accepted position.  Toggled by
 ``serving.speculative.*`` / per-request ``Request.speculative``.
 
+Resilience (``serving.resilience.*``; docs/robustness.md): with the
+group enabled, the fused step additionally returns a per-slot
+finiteness verdict on exactly the logit rows the commit consumes — the
+PR-2 sentinel pattern, in-trace, zero extra host syncs (the verdict
+rides the step's own token fetch) — and gates each slot's cursor
+advance on it, so a bad step never moves device state.  The host side
+then simply replans: the retry re-feeds identical tokens (exact by
+construction), persistent offenders are requeued with their committed
+prefix (scheduler.requeue_slot — replay through chunked prefill
+rebuilds KV and cursors bit-exactly), and hopeless ones are failed.
+Overload is answered at submit (bounded queue + shedding) and by the
+degradation ladder (speculation off -> prefill budget tightened ->
+shed), never by touching admitted requests' outputs.
+
 Exactness contract: greedy engine output is bit-identical (token ids)
 to ``generate(use_cache=True)`` per request — the legacy path stays the
-oracle (tests/test_serving.py), including requests admitted mid-flight
-and slots reused after retirement.  Greedy SPECULATIVE output keeps the
-same contract (exact-match acceptance); sampled speculative output
-keeps the sampling distribution, not the bitstream
+oracle (tests/test_serving.py), including requests admitted mid-flight,
+slots reused after retirement, retried/requeued slots, and degradation
+transitions (tests/test_serving_resilience.py).  Greedy SPECULATIVE
+output keeps the same contract (exact-match acceptance); sampled
+speculative output keeps the sampling distribution, not the bitstream
 (tests/test_serving_speculative.py).
 """
 
 from __future__ import annotations
 
 import time
+import weakref
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -53,6 +71,8 @@ from easyparallellibrary_tpu.observability import trace as trace_lib
 from easyparallellibrary_tpu.serving import kv_cache as kv_lib
 from easyparallellibrary_tpu.serving._capabilities import (
     check_draft_fits_chunk, check_servable)
+from easyparallellibrary_tpu.serving.resilience import (
+    AdmissionController, BadStepPolicy, DEGRADE_LEVELS)
 from easyparallellibrary_tpu.serving.scheduler import (
     FCFSScheduler, FinishedRequest, Request, _slot_track)
 from easyparallellibrary_tpu.utils.logging import get_logger
@@ -112,6 +132,33 @@ def sample_token_slots(logits, keys, temperature, top_k, top_p):
   return jnp.where(temperature <= 0, greedy, sampled).astype(jnp.int32)
 
 
+def _resolve_mesh(mesh):
+  """The engine's placement mesh: the caller's, else the ambient Env
+  mesh when one has been BUILT (never force-building one).
+
+  This closes the fit->engine recompile interplay (ROADMAP item 1
+  "First"; NOTES.md): once any component builds the cluster mesh (fit's
+  setup does), ``utils.sharding.constrain`` binds every activation
+  constraint inside the fused step to ``NamedSharding(mesh, ...)`` —
+  so the step's OUTPUTS come back committed to that mesh even when the
+  engine was constructed meshless, while its first-call inputs (a fresh
+  meshless cache) were uncommitted single-device arrays.  Call 2's
+  donated inputs then carry a different sharding signature than call
+  1's and the step recompiles exactly once.  Adopting the ambient mesh
+  makes allocation, in_shardings and out_shardings agree from the first
+  call (replicated specs degrade gracefully on a 1-device mesh), so the
+  compile-once contract holds in any construction order.
+  """
+  if mesh is not None:
+    return mesh
+  cluster = getattr(Env.get(), "cluster", None)
+  if cluster is not None:
+    # built_mesh observes without forcing a build (Cluster.mesh would
+    # force one) — a truly meshless run must stay meshless.
+    return getattr(cluster, "built_mesh", None)
+  return None
+
+
 class ContinuousBatchingEngine:
   """Slot-based continuous-batching decode engine for a (non-pipelined)
   GPT.
@@ -127,6 +174,11 @@ class ContinuousBatchingEngine:
       eng = ContinuousBatchingEngine(model, params, mesh=mesh)
       eng.submit(Request(uid="a", prompt=ids, max_new_tokens=32))
       outputs = eng.run()          # {uid: prompt+generated np.int32}
+      eng.finished["a"].finish_reason   # why each request ended
+
+  ``submit`` returns False when admission control sheds the request
+  (``serving.resilience.queue_limit``); the shed record still lands in
+  ``engine.finished`` with reason ``"shed"``.
   """
 
   def __init__(self, model, params, *, mesh=None,
@@ -138,6 +190,7 @@ class ContinuousBatchingEngine:
                donate_cache: Optional[bool] = None,
                drafter=None, speculative: Optional[bool] = None,
                draft_model=None, draft_params=None,
+               resilience: Optional[bool] = None,
                stats=None, metrics_writer=None, registry=None,
                config=None):
     cfg = model.cfg
@@ -149,7 +202,7 @@ class ContinuousBatchingEngine:
     check_servable(cfg)
     self.model = model
     self.params = params
-    self.mesh = mesh
+    self.mesh = _resolve_mesh(mesh)
     self.num_slots = num_slots if num_slots is not None else conf.num_slots
     self.chunk = (prefill_chunk if prefill_chunk is not None
                   else conf.prefill_chunk)
@@ -171,18 +224,88 @@ class ContinuousBatchingEngine:
         stop_token=stop_token if stop_token is not None
         else conf.stop_token,
         spec_k=self.drafter.k if self.drafter is not None else 0)
+    res_conf = conf.resilience
+    self._resilient = (resilience if resilience is not None
+                       else res_conf.enabled)
     self.stats = stats
+    if self._resilient and self.stats is None:
+      # The degradation ladder reads measured ITL from ServingStats;
+      # auto-build one rather than silently losing that signal.
+      from easyparallellibrary_tpu.profiler.serving import ServingStats
+      self.stats = ServingStats(finished_limit=conf.finished_limit)
     self.metrics_writer = metrics_writer
     # Optional MetricRegistry (observability/registry.py): per-step
     # records publish under serving/* through the one metric schema.
     self.registry = registry
-    if stats is not None:
-      self.scheduler.on_admit = stats.note_admitted
-      self.scheduler.on_first_token = stats.note_first_token
-      self.scheduler.on_finish = lambda fin: stats.note_finished(
-          fin.uid, fin.new_tokens)
+    # Finish records by uid (reasons incl. shed/deadline/cancelled) —
+    # bounded to the most recent serving.finished_limit entries (0 =
+    # keep all; a long-running server must bound this or grow host
+    # memory linearly with requests served).
+    self.finished: Dict[Any, FinishedRequest] = {}
+    self._finished_limit = conf.finished_limit
+    self.scheduler.on_finish.append(self._record_finished)
+    if self.stats is not None:
+      stats_obj = self.stats
+      self.scheduler.on_admit.append(stats_obj.note_admitted)
+      self.scheduler.on_first_token.append(stats_obj.note_first_token)
+      self.scheduler.on_finish.append(
+          lambda fin: stats_obj.note_finished(fin.uid, fin.new_tokens,
+                                              fin.finish_reason))
+    self._admission: Optional[AdmissionController] = None
+    self._bad_policy: Optional[BadStepPolicy] = None
+    self._watchdog = None
+    if self._resilient:
+      self._admission = AdmissionController(
+          queue_limit=res_conf.queue_limit,
+          itl_slo_s=res_conf.itl_slo_s,
+          degrade_queue_frac=res_conf.degrade_queue_frac,
+          on_transition=self._on_degrade_transition)
+      self._bad_policy = BadStepPolicy(
+          max_step_retries=res_conf.max_step_retries,
+          max_requeues=res_conf.max_requeues)
+      if res_conf.step_timeout_s > 0:
+        from easyparallellibrary_tpu.runtime.resilience import StepWatchdog
+        # on_timeout binds the STATS object, not an engine method: the
+        # finalizer below pins the watchdog, so a watchdog->engine
+        # reference would pin the engine too and the finalizer could
+        # never fire.
+        stats_obj = self.stats
+        self._watchdog = StepWatchdog(
+            res_conf.step_timeout_s,
+            on_timeout=(None if stats_obj is None else
+                        lambda step: stats_obj.note_watchdog_timeout()),
+            knob="serving.resilience.step_timeout_s")
+        # The monitor thread's target is a bound watchdog method, so the
+        # thread pins the watchdog and never exits without close() — a
+        # discarded engine would otherwise leak one live
+        # 'epl-step-watchdog' thread per construction (the training
+        # loop closes its own watchdog in fit(); the engine must not
+        # depend on the caller remembering to).  The finalizer holds
+        # the WATCHDOG, not the engine, so the engine stays collectible.
+        self._watchdog_finalizer = weakref.finalize(
+            self, self._watchdog.close)
+    self._drafter_failures = 0
+    self._drafter_fail_logged = False
     self._kv, self._cursors = kv_lib.allocate_kv_cache(
-        cfg, self.num_slots, self.chunk, mesh)
+        cfg, self.num_slots, self.chunk, self.mesh)
+    # Quarantine hygiene: a poisoned device step leaves non-finite K/V
+    # in a bad slot's cache, and slot_cache_attend's V contraction
+    # touches every cache row (0 * NaN = NaN), so the poison must be
+    # zeroed before the slot is read again.  A freed slot is zeroed
+    # whole (its next occupant starts from row 0); a retried slot is
+    # zeroed from its committed cursor up — the retry is only
+    # guaranteed to rewrite its OWN grant window, which can be smaller
+    # than the bad step's (speculation degraded off, drafter fault,
+    # prefill budget tightened between steps).  Separate tiny program;
+    # dispatched only on bad-step events, compiles once.
+    self._sanitize_fn = jax.jit(
+        lambda kv, mask, start: jax.tree_util.tree_map(
+            lambda x: jnp.where(
+                mask[:, None, None, None]
+                & (jnp.arange(x.shape[1])[None, :, None, None]
+                   >= start[:, None, None, None]),
+                jnp.zeros((), x.dtype), x), kv),
+        donate_argnums=0) if self._resilient else None
     # Perfetto track name per slot (the scheduler's lifecycle spans and
     # the engine's per-step spans must land on the same track);
     # precomputed so the per-step tracing loop does no string work.
@@ -191,18 +314,22 @@ class ContinuousBatchingEngine:
     donate = conf.donate_cache if donate_cache is None else donate_cache
     if self.drafter is not None:
       self.drafter.bind(self)
-      self._step_fn = self._build_spec_step(donate)
+      self._step_fn = self._build_spec_step(donate, self._resilient)
     else:
-      self._step_fn = self._build_step(donate)
+      self._step_fn = self._build_step(donate, self._resilient)
     get_logger().info(
         "serving engine: %d slots x chunk %d (cache %.1f MB, %s), "
-        "prefill budget %s, max batch %d, speculation %s",
+        "prefill budget %s, max batch %d, speculation %s, resilience %s",
         self.num_slots, self.chunk,
         kv_lib.cache_bytes(cfg, self.num_slots, self.chunk) / 1e6,
-        "mesh-sharded" if mesh is not None else "single-program",
+        "mesh-sharded" if self.mesh is not None else "single-program",
         budget or "uncapped", self.scheduler.max_batch,
         f"{type(self.drafter).__name__}(k={self.drafter.k})"
-        if self.drafter is not None else "off")
+        if self.drafter is not None else "off",
+        f"on (queue_limit {res_conf.queue_limit or 'unbounded'}, "
+        f"itl_slo {res_conf.itl_slo_s or 'off'}, watchdog "
+        f"{res_conf.step_timeout_s or 'off'})"
+        if self._resilient else "off")
 
   def _resolve_drafter(self, conf, drafter, speculative, draft_model,
                        draft_params):
@@ -232,6 +359,19 @@ class ContinuousBatchingEngine:
       check_draft_fits_chunk(drafter.k, self.chunk)
     return drafter
 
+  # --------------------------------------------------- resilience hooks
+
+  def _on_degrade_transition(self, old: int, new: int, signals):
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.instant(
+          "serving/degraded", cat="serving", track="serving",
+          args={"from": DEGRADE_LEVELS[old], "to": DEGRADE_LEVELS[new],
+                **signals})
+      tracer.counter("serving/degraded_level", new)
+    if self.stats is not None:
+      self.stats.note_degraded(new)
+
   # ----------------------------------------------------------- device step
 
   def _jit_step(self, step, donate: bool, n_rep_in: int, n_rep_out: int):
@@ -251,7 +391,7 @@ class ContinuousBatchingEngine:
       jit_kwargs["out_shardings"] = (rep,) * n_rep_out + (kv_sh, cur_sh)
     return jax.jit(step, **jit_kwargs)
 
-  def _build_step(self, donate: bool):
+  def _build_step(self, donate: bool, guard: bool = False):
     from easyparallellibrary_tpu.models.gpt import slot_step_logits
     model = self.model
     C = self.chunk
@@ -269,11 +409,21 @@ class ContinuousBatchingEngine:
       step_keys = jax.vmap(jax.random.fold_in)(keys, tok_index)
       nxt = sample_token_slots(last.astype(jnp.float32), step_keys,
                                temperature, top_k, top_p)
-      return nxt, kv, cursors + num_valid
+      if not guard:
+        return nxt, kv, cursors + num_valid
+      # In-jit finiteness verdict on exactly the rows commit consumes
+      # (the PR-2 sentinel pattern): a bad slot's cursor stays put, so
+      # its K/V writes beyond the old cursor are unreachable garbage the
+      # retry overwrites — device state never advances on a bad step.
+      slot_ok = (jnp.all(jnp.isfinite(last), axis=-1)
+                 | (num_valid == 0))
+      return nxt, slot_ok, kv, jnp.where(slot_ok, cursors + num_valid,
+                                         cursors)
 
-    return self._jit_step(step, donate, n_rep_in=8, n_rep_out=1)
+    return self._jit_step(step, donate, n_rep_in=8,
+                          n_rep_out=2 if guard else 1)
 
-  def _build_spec_step(self, donate: bool):
+  def _build_spec_step(self, donate: bool, guard: bool = False):
     """The speculative twin of :meth:`_build_step`: the SAME single
     model call (drafts ride the chunk positions plain decode wastes, so
     verification adds no model compute), followed by in-jit per-slot
@@ -310,20 +460,104 @@ class ContinuousBatchingEngine:
       # non-draft tokens plus the accepted prefix; rejected-draft K/V
       # beyond the new cursor is masked and later overwritten, exactly
       # like chunked-prefill garbage.
-      return committed, n_committed, kv, cursors + base + accepted
+      if not guard:
+        return committed, n_committed, kv, cursors + base + accepted
+      # All K+1 target rows of a healthy slot are gathers of real
+      # (finite) logit positions, so checking the whole [K+1, V] block
+      # is safe and covers every row verification consumed.
+      slot_ok = (jnp.all(jnp.isfinite(tgt), axis=(1, 2))
+                 | (num_valid == 0))
+      new_cursors = jnp.where(slot_ok, cursors + base + accepted,
+                              cursors)
+      return committed, n_committed, slot_ok, kv, new_cursors
 
-    return self._jit_step(step, donate, n_rep_in=9, n_rep_out=2)
+    return self._jit_step(step, donate, n_rep_in=9,
+                          n_rep_out=3 if guard else 2)
 
   # ------------------------------------------------------------ host loop
 
-  def submit(self, request: Request):
+  def _record_finished(self, fin: FinishedRequest) -> None:
+    """Record a resolution in ``finished``, evicting oldest-first past
+    ``serving.finished_limit`` (0 = unbounded)."""
+    # pop first: re-assigning an existing key would keep its ORIGINAL
+    # dict insertion position, so a reused uid's fresh record would be
+    # evicted as if it were the oldest.
+    self.finished.pop(fin.uid, None)
+    self.finished[fin.uid] = fin
+    if self._finished_limit > 0:
+      while len(self.finished) > self._finished_limit:
+        self.finished.pop(next(iter(self.finished)))
+
+  def submit(self, request: Request) -> bool:
+    """Enqueue `request`; returns False when admission control sheds it
+    (bounded queue full, or the ladder is at its shed level).  Shed
+    records land in ``self.finished`` with reason ``"shed"`` and are
+    never admitted — the client learns at submit time, not after a
+    hopeless queue wait.  Malformed requests raise regardless of load
+    (validation must not depend on instantaneous queue depth)."""
+    prompt = self.scheduler.validate(request)
+    if self._admission is not None and not self.scheduler.has_work:
+      # The ladder normally de-escalates inside step(), but an idle
+      # engine never steps: if the queue drained without stepping
+      # (every queued request cancelled or expired after a shed-level
+      # observation), a stale shed level would otherwise reject 100%
+      # of traffic forever.  Re-observe with the idle signals first.
+      self._apply_degradation()
+    if (self._admission is not None
+        and self._admission.should_shed(self.scheduler.queue_depth)):
+      self._admission.note_shed()
+      fin = FinishedRequest(uid=request.uid, tokens=prompt,
+                            new_tokens=0, finish_reason="shed")
+      self._record_finished(fin)
+      if self.stats is not None:
+        self.stats.note_shed(request.uid)
+      tracer = trace_lib.get_tracer()
+      if tracer.enabled:
+        tracer.instant(
+            "serving/shed", cat="serving", track="serving/requests",
+            args={"uid": str(request.uid),
+                  "queue_depth": int(self.scheduler.queue_depth),
+                  "level": DEGRADE_LEVELS[self._admission.level]})
+      get_logger().warning(
+          "shedding request %r at submit (queue %d/%d, level %s)",
+          request.uid, self.scheduler.queue_depth,
+          self._admission.queue_limit,
+          DEGRADE_LEVELS[self._admission.level])
+      return False
     if self.stats is not None:
       self.stats.note_submitted(request.uid)
-    self.scheduler.submit(request)
+    self.scheduler.submit(request, _prompt=prompt)
+    return True
+
+  def cancel(self, uid: Any) -> bool:
+    """Client cancellation: retire `uid` wherever it is; the record (and
+    any partial output) lands in ``self.finished`` immediately (the
+    on_finish hook fires inside this call), and the retirement is also
+    returned by the next ``step()``.  Returns False for
+    unknown/already-finished uids."""
+    return self.scheduler.cancel(uid)
 
   @property
   def has_work(self) -> bool:
     return self.scheduler.has_work
+
+  def close(self):
+    """Release background resources (the hung-step watchdog thread).
+    Idempotent; the engine remains usable for stepping afterwards —
+    the watchdog simply stops firing.  Also runs automatically when the
+    engine is garbage-collected (or at interpreter exit) and on
+    ``with`` exit, so un-closed engines never leak monitor threads."""
+    if self._watchdog is not None:
+      self._watchdog.close()
+      self._watchdog = None
+      self._watchdog_finalizer.detach()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+    return False
 
   def _trace_slot_spans(self, tracer, plan, t0_us: float, t1_us: float,
                         num_draft=None, n_committed=None):
@@ -352,61 +586,222 @@ class ContinuousBatchingEngine:
                        track=track,
                        args={"tok_index": int(plan.tok_index[slot])})
 
-  def step(self) -> List[FinishedRequest]:
-    """One engine iteration: plan -> [draft ->] fused device step ->
-    commit.  Returns the requests that retired this iteration (empty
-    when idle)."""
-    tracer = trace_lib.get_tracer()
-    with tracer.span("serving/plan", cat="serving", track="serving"):
-      plan = self.scheduler.plan_step()
-    if plan is None:
-      return []
-    t0 = time.monotonic()
-    drafted = accepted = 0
-    if self.drafter is not None:
-      # Propose BEFORE the token block gains drafts: the draft model's
-      # mirror call needs the same plan the target sees.
-      with tracer.span("serving/draft", cat="serving", track="serving"):
+  def _apply_degradation(self):
+    """Feed the ladder this iteration's post-admission load signals and
+    apply its level to the scheduler (speculation gate, budget clamp).
+    Occupancy is relative to the EFFECTIVE concurrency cap — with
+    max_batch < num_slots the batch saturates below full slot count,
+    and budget_tight's occupancy gate must still be reachable."""
+    itl = self.stats.itl_ewma_s if self.stats is not None else 0.0
+    cap = min(self.num_slots, self.scheduler.max_batch)
+    self._admission.observe(
+        self.scheduler.queue_depth,
+        self.scheduler.num_active / cap, itl)
+    self.scheduler.spec_enabled = self._admission.speculation_enabled
+    self.scheduler.budget_override = (
+        self.chunk if self._admission.budget_tightened else 0)
+
+  def _propose_drafts(self, tracer, plan):
+    """Run the drafter for one step, tolerating drafter faults: a
+    raising drafter degrades to zero drafts for the step (verification
+    would reject garbage anyway — a flaky drafter may cost speed,
+    never correctness), and a degraded ladder (spec_off and above)
+    skips draft compute outright — the first ballast under overload."""
+    N = plan.tokens.shape[0]
+    if not self.scheduler.spec_enabled:
+      # getattr: observe_skip postdates the drafter protocol — a
+      # duck-typed pre-resilience drafter must not crash the engine the
+      # first time the ladder reaches spec_off.
+      skip = getattr(self.drafter, "observe_skip", None)
+      if skip is not None:
+        skip(plan)
+      return np.zeros((N,), np.int32)
+    with tracer.span("serving/draft", cat="serving", track="serving"):
+      try:
         histories = self.scheduler.slot_histories(plan)
         draft_tokens, num_draft = self.drafter.propose(plan, histories)
-        num_draft = np.minimum(
-            np.asarray(num_draft, np.int32), plan.draft_cap)
+        # Clip (not minimum): a malformed proposal with a NEGATIVE count
+        # must clamp to zero drafts, not ride into the token writes.
+        num_draft = np.clip(np.asarray(num_draft, np.int32),
+                            0, plan.draft_cap)
+        # Inside the try: a propose() that returns malformed shapes
+        # without raising fails HERE, and must degrade like any other
+        # drafter fault rather than crash the step.
         for slot in np.nonzero(num_draft)[0]:
           nd = int(num_draft[slot])
           plan.tokens[slot, 1:1 + nd] = draft_tokens[slot, :nd]
-      t0_us = tracer.now_us()
-      committed, n_committed, self._kv, self._cursors = self._step_fn(
-          self.params, self._kv, self._cursors, plan.tokens,
-          plan.num_valid + num_draft, num_draft, plan.reset, plan.keys,
-          plan.tok_index, plan.temperature, plan.top_k, plan.top_p)
-      committed = np.asarray(committed)
-      n_committed = np.asarray(n_committed)
-      t1_us = tracer.now_us()
-      tracer.span_at("serving/device_step", t0_us, t1_us, cat="serving",
-                     track="serving")
-      self._trace_slot_spans(tracer, plan, t0_us, t1_us,
-                             num_draft, n_committed)
-      with tracer.span("serving/commit", cat="serving", track="serving"):
-        finished = self.scheduler.commit(committed, n_committed)
-        self.drafter.observe_commit(self._cursors)
-      speculated = num_draft > 0
-      drafted = int(num_draft.sum())
-      accepted = int((n_committed[speculated] - 1).sum())
-    else:
-      t0_us = tracer.now_us()
-      nxt, self._kv, self._cursors = self._step_fn(
-          self.params, self._kv, self._cursors, plan.tokens,
-          plan.num_valid, plan.reset, plan.keys, plan.tok_index,
-          plan.temperature, plan.top_k, plan.top_p)
-      nxt = np.asarray(nxt)
-      t1_us = tracer.now_us()
-      tracer.span_at("serving/device_step", t0_us, t1_us, cat="serving",
-                     track="serving")
-      self._trace_slot_spans(tracer, plan, t0_us, t1_us)
-      with tracer.span("serving/commit", cat="serving", track="serving"):
-        finished = self.scheduler.commit(nxt)
+      except Exception as e:  # noqa: BLE001 — any drafter fault degrades
+        self._drafter_failures += 1
+        if not self._drafter_fail_logged:
+          self._drafter_fail_logged = True
+          get_logger().warning(
+              "drafter %s failed (%s: %s); serving continues without "
+              "drafts this step (logged once; see "
+              "serving/drafter_failures)", type(self.drafter).__name__,
+              type(e).__name__, e)
+        if tracer.enabled:
+          tracer.instant("serving/drafter_failure", cat="serving",
+                         track="serving",
+                         args={"error": type(e).__name__})
+        # Partial draft writes before the failure are harmless: with
+        # zero drafts every decode slot's num_valid stays 1, so the
+        # written positions are masked garbage the step never reads.
+        return np.zeros((N,), np.int32)
+    return num_draft
+
+  def _handle_bad_slots(self, plan, slot_ok: np.ndarray) -> List[int]:
+    """Post-commit bad-step policy: update streaks, requeue/fail the
+    slots the policy quarantines.  Returns the bad slot list."""
+    bad = [int(s) for s in
+           np.nonzero(~slot_ok & (plan.num_valid > 0))[0]]
+    exercised = {int(s) for s in np.nonzero(plan.num_valid)[0]}
+    actions = self._bad_policy.judge(self.scheduler.active, bad,
+                                     exercised=exercised)
+    if not bad:
+      return bad
+    tracer = trace_lib.get_tracer()
+    retries = sum(1 for a in actions.values() if a == BadStepPolicy.RETRY)
+    if tracer.enabled:
+      tracer.instant(
+          "serving/bad_step", cat="serving", track="serving",
+          args={"slots": bad, "retries": retries})
+    get_logger().warning(
+        "bad device step (non-finite logits) on slot(s) %s: %s", bad,
+        {s: a for s, a in actions.items()})
+    slot_starts: Dict[int, int] = {}
+    cursors = None
+    for slot, action in actions.items():
+      if action == BadStepPolicy.REQUEUE:
+        self.scheduler.requeue_slot(slot, reason="bad_step")
+        slot_starts[slot] = 0
+      elif action == BadStepPolicy.FAIL:
+        self.scheduler.retire_slot(slot, "failed")
+        slot_starts[slot] = 0
+      else:  # RETRY: zero the bad step's uncommitted writes only.
+        if cursors is None:  # host sync on the rare bad-step path only
+          cursors = np.asarray(self._cursors)
+        slot_starts[slot] = int(cursors[slot])
+    if slot_starts:
+      self._sanitize_slots(slot_starts)
+    if self.stats is not None:
+      # Single source of truth: the policy already counted this event.
+      self.stats.sync_bad_step_counters(self._bad_policy.counters())
+    return bad
+
+  def _sanitize_slots(self, slot_starts: Dict[int, int]) -> None:
+    """Zero poisoned slots' K/V from each slot's start row up
+    (slot_cache_attend's finiteness invariant: masking zeroes a stale
+    row's softmax probability, but the V contraction still touches every
+    cache row and ``0 * NaN = NaN``).  Freed slots pass start 0 (the
+    next occupant must see a clean slot); retried slots pass their
+    committed cursor (the prefix is real — only the bad step's writes
+    above it are suspect, and the retry's grant may not cover them
+    all)."""
+    mask = np.zeros((self.num_slots,), bool)
+    start = np.zeros((self.num_slots,), np.int32)
+    for slot, row in slot_starts.items():
+      mask[slot] = True
+      start[slot] = row
+    self._kv = self._sanitize_fn(self._kv, mask, start)
+
+  def step(self) -> List[FinishedRequest]:
+    """One engine iteration: [degrade ->] plan -> [draft ->] fused
+    device step -> commit [-> bad-step policy].  Returns the requests
+    that retired this iteration (empty when idle), expiries and
+    cancellations included."""
+    tracer = trace_lib.get_tracer()
+    with tracer.span("serving/plan", cat="serving", track="serving"):
+      plan = self.scheduler.plan_step()
+    if self._admission is not None:
+      # Observe AFTER admission: the ladder's queue signal is the
+      # backlog this step could NOT absorb — a one-shot burst that
+      # admission fully drains must not read as overload (it would
+      # falsely shed follow-up submits for the hysteresis window).
+      # The resulting gates steer the NEXT plan; one step of lag is
+      # the price of measuring the right signal.
+      self._apply_degradation()
+    if plan is None:
+      # No device work, but plan-time expiries may have retired
+      # requests (e.g. every queued request's deadline passed).
+      return self.scheduler.take_finished()
+    t0 = time.monotonic()
+    if self._watchdog is not None:
+      self._watchdog.arm(self._steps)
+    drafted = accepted = 0
+    slot_ok = None
+    try:
+      if self.drafter is not None:
+        # Propose BEFORE the token block gains drafts: the draft
+        # model's mirror call needs the same plan the target sees.
+        num_draft = self._propose_drafts(tracer, plan)
+        t0_us = tracer.now_us()
+        out = self._step_fn(
+            self.params, self._kv, self._cursors, plan.tokens,
+            plan.num_valid + num_draft, num_draft, plan.reset, plan.keys,
+            plan.tok_index, plan.temperature, plan.top_k, plan.top_p)
+        if self._resilient:
+          committed, n_committed, ok_dev, self._kv, self._cursors = out
+          slot_ok = np.asarray(ok_dev)
+        else:
+          committed, n_committed, self._kv, self._cursors = out
+        committed = np.asarray(committed)
+        n_committed = np.asarray(n_committed)
+        t1_us = tracer.now_us()
+        tracer.span_at("serving/device_step", t0_us, t1_us,
+                       cat="serving", track="serving")
+        self._trace_slot_spans(tracer, plan, t0_us, t1_us,
+                               num_draft, n_committed)
+        with tracer.span("serving/commit", cat="serving",
+                         track="serving"):
+          finished = self.scheduler.commit(committed, n_committed,
+                                           slot_ok=slot_ok)
+          self.drafter.observe_commit(self._cursors)
+        # Stats count only slots whose verdict committed: a bad slot's
+        # n_committed is NaN-logit garbage and its drafts are re-spent
+        # on the retry — counting them would double/poison the
+        # acceptance-rate samples under chaos.
+        ok = np.ones(num_draft.shape, bool) if slot_ok is None else slot_ok
+        speculated = (num_draft > 0) & ok
+        drafted = int(num_draft[ok].sum())
+        accepted = int((n_committed[speculated] - 1).sum())
+      else:
+        t0_us = tracer.now_us()
+        out = self._step_fn(
+            self.params, self._kv, self._cursors, plan.tokens,
+            plan.num_valid, plan.reset, plan.keys, plan.tok_index,
+            plan.temperature, plan.top_k, plan.top_p)
+        if self._resilient:
+          nxt, ok_dev, self._kv, self._cursors = out
+          slot_ok = np.asarray(ok_dev)
+        else:
+          nxt, self._kv, self._cursors = out
+        nxt = np.asarray(nxt)
+        t1_us = tracer.now_us()
+        tracer.span_at("serving/device_step", t0_us, t1_us,
+                       cat="serving", track="serving")
+        self._trace_slot_spans(tracer, plan, t0_us, t1_us)
+        with tracer.span("serving/commit", cat="serving",
+                         track="serving"):
+          finished = self.scheduler.commit(nxt, slot_ok=slot_ok)
+    finally:
+      if self._watchdog is not None:
+        self._watchdog.disarm()
+    if slot_ok is not None:
+      self._handle_bad_slots(plan, slot_ok)
+      # Quarantine retirements ("failed") belong to this iteration.
+      finished.extend(self.scheduler.take_finished())
     self._steps += 1
     dt = time.monotonic() - t0
+    # Throughput/ITL samples count COMMITTED tokens only: a bad slot's
+    # planned tokens never committed and the identical work is re-fed
+    # next step — counting both would double prefill/decode throughput
+    # under chaos (same rule as the drafted/accepted exclusion above).
+    if slot_ok is None or bool(slot_ok.all()):
+      pf_tokens, dc_tokens = plan.prefill_tokens, plan.decode_tokens
+    else:
+      ok = (plan.num_valid > 0) & slot_ok
+      pf_tokens = int(plan.num_valid[ok & plan.prefilling].sum())
+      dc_tokens = int((ok & ~plan.prefilling).sum())
     if tracer.enabled:
       tracer.counter("serving/active_slots", plan.active_slots)
       if drafted:
@@ -415,20 +810,26 @@ class ContinuousBatchingEngine:
     if self.stats is not None:
       self.stats.note_step(
           active_slots=plan.active_slots, num_slots=self.num_slots,
-          prefill_tokens=plan.prefill_tokens,
-          decode_tokens=plan.decode_tokens, step_time_s=dt,
+          prefill_tokens=pf_tokens,
+          decode_tokens=dc_tokens, step_time_s=dt,
           drafted_tokens=drafted, accepted_tokens=accepted)
     if self.metrics_writer is not None or self.registry is not None:
       record = {
           "active_slots": plan.active_slots,
           "slot_occupancy": plan.active_slots / self.num_slots,
-          "prefill_tokens": plan.prefill_tokens,
-          "decode_tokens": plan.decode_tokens,
+          "prefill_tokens": pf_tokens,
+          "decode_tokens": dc_tokens,
           "step_time_s": dt,
       }
       if self.drafter is not None:
         record["drafted_tokens"] = drafted
         record["accepted_tokens"] = accepted
+        record["drafter_failures"] = self._drafter_failures
+      if self._resilient:
+        record["queue_depth"] = self.scheduler.queue_depth
+        record["degraded_level"] = self._admission.level
+        record["shed"] = self._admission.shed_total
+        record.update(self._bad_policy.counters())
       if self.metrics_writer is not None:
         # Legacy flat keys (pre-registry callers depend on them).
         self.metrics_writer.write(self._steps, record)
@@ -440,7 +841,7 @@ class ContinuousBatchingEngine:
           ) -> Dict[Any, np.ndarray]:
     """Drive until the queue drains (or ``max_steps``); returns
     ``{uid: prompt+generated}`` for every request finished during the
-    call."""
+    call (finish reasons: ``self.finished[uid].finish_reason``)."""
     out: Dict[Any, np.ndarray] = {}
     steps = 0
     while self.has_work and (max_steps is None or steps < max_steps):
@@ -449,6 +850,6 @@ class ContinuousBatchingEngine:
       steps += 1
     if self.registry is not None and self.stats is not None:
       # End-of-drive rollup (tokens/s, TTFT/ITL percentiles, occupancy,
-      # speculation counters) under the serving/* namespace.
+      # speculation + resilience counters) under the serving/* namespace.
       self.stats.publish(self.registry, self._steps)
     return out

@@ -1,0 +1,257 @@
+"""Serving-side resilience: admission control, overload shedding,
+degradation, and bad-step recovery policy.
+
+PR 2 gave *training* graded fault responses (runtime/resilience.py:
+in-jit sentinel, rollback, watchdog); this module gives the serving
+engine the same "unchanged user code, resilient system underneath"
+treatment for what production traffic and flaky hardware actually do:
+
+* **Admission control & shedding** — :class:`AdmissionController`, a
+  bounded admission queue plus a degradation ladder driven by live load
+  signals (queue depth, slot occupancy, measured ITL vs its SLO).
+  Pressure is answered in cost order: speculation off first (draft
+  compute is pure ballast under overload), then prefill-budget
+  tightening (protect decode cadence), then shedding new arrivals at
+  submit (reason ``"shed"``) — never by corrupting or abandoning
+  admitted work.  Every ladder transition is emitted as a trace instant
+  (``serving/degraded``) on the PR-5 tracer and counted.
+* **Bad-step policy** — :class:`BadStepPolicy` tracks per-slot
+  consecutive bad device steps (the in-jit finiteness verdict the
+  guarded fused step returns; engine.py) and decides retry vs
+  quarantine: a bad slot's cursor never advanced, so the next plan
+  re-feeds identical work (the retry is free and exact); past
+  ``max_step_retries`` the request is requeued with its committed
+  prefix (scheduler.requeue_slot), and past ``max_requeues`` it is
+  failed rather than allowed to poison the batch forever.
+* **Hung-step watchdog** — the engine arms a
+  :class:`runtime.resilience.StepWatchdog` around each fused-step
+  dispatch+fetch when ``serving.resilience.step_timeout_s`` > 0, so a
+  wedged device call surfaces in the log/trace with a step number
+  instead of as silence.
+
+Everything here is pure host policy — no device work, no jax imports —
+so it is unit-testable with a fake clock and adds zero overhead to the
+fused step.  Knobs: the ``serving.resilience.*`` config group
+(docs/robustness.md "Serving resilience").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from easyparallellibrary_tpu.utils.logging import get_logger
+
+# Degradation ladder levels, in escalation order.  The index is the
+# level number the engine/metrics carry.
+DEGRADE_LEVELS = ("normal", "spec_off", "budget_tight", "shed")
+
+
+class AdmissionController:
+  """Bounded admission queue + graceful-degradation ladder.
+
+  ``queue_limit`` bounds how many requests may wait for a slot; a
+  submit that finds the queue full is shed immediately (early
+  rejection: the client learns NOW instead of after a hopeless wait).
+  Below the hard limit, the ladder degrades service quality in cost
+  order as pressure builds:
+
+  ========  ============  ==============================================
+  level     name          effect (applied by the engine)
+  ========  ============  ==============================================
+  0         normal        full service
+  1         spec_off      speculation disabled (draft compute freed for
+                          committed tokens; exactness unaffected)
+  2         budget_tight  per-step prefill budget clamped to one chunk
+                          (admission slows, decode cadence protected)
+  3         shed          new submits rejected (reason ``"shed"``)
+  ========  ============  ==============================================
+
+  Level entry thresholds are queue-depth fractions of ``queue_limit``
+  (``degrade_queue_frac`` enters level 1, halfway between it and full
+  enters level 2, full enters level 3); an ITL measurement above
+  ``itl_slo_s`` forces at least level 1 regardless of queue depth.
+  Level 2 additionally requires full slot occupancy — tightening the
+  prefill budget while slots sit empty would slow the very admissions
+  that drain the queue.  De-escalation is hysteretic: a level is left
+  only once the queue has drained below HALF its entry threshold, one
+  level per observation, so the ladder cannot flap on a noisy boundary
+  — except that ``budget_tight`` is also released the moment occupancy
+  drops below full (its entry condition), for the same reason it
+  requires full occupancy to enter.  An over-SLO ITL holds the ladder
+  at ``spec_off`` or above (it floors the target level at 1) but never
+  pins the higher levels.
+
+  ``on_transition(old_level, new_level, signals)`` fires on every
+  ladder move (the engine hooks the tracer + stats counters in).
+  """
+
+  def __init__(self, queue_limit: int = 0, itl_slo_s: float = 0.0,
+               degrade_queue_frac: float = 0.5,
+               on_transition: Optional[Callable] = None):
+    if queue_limit < 0:
+      raise ValueError(f"queue_limit must be >= 0 (0 = unbounded): "
+                       f"{queue_limit}")
+    if not 0.0 < degrade_queue_frac <= 1.0:
+      raise ValueError(f"degrade_queue_frac must be in (0, 1]: "
+                       f"{degrade_queue_frac}")
+    if itl_slo_s < 0:
+      raise ValueError(f"itl_slo_s must be >= 0 (0 = off): {itl_slo_s}")
+    self.queue_limit = queue_limit
+    self.itl_slo_s = itl_slo_s
+    self.degrade_queue_frac = degrade_queue_frac
+    self.on_transition = on_transition
+    self.level = 0
+    self.transitions = 0
+    self.shed_total = 0
+
+  # --------------------------------------------------------------- levels
+
+  def _enter_frac(self, level: int) -> float:
+    """Queue-depth fraction at which `level` is entered."""
+    if level >= 3:
+      return 1.0
+    if level == 2:
+      return (1.0 + self.degrade_queue_frac) / 2.0
+    return self.degrade_queue_frac
+
+  def _target_level(self, queue_frac: float, occupancy: float,
+                    itl_over: bool) -> int:
+    level = 0
+    if self.queue_limit > 0:
+      if queue_frac >= self._enter_frac(3):
+        level = 3
+      elif queue_frac >= self._enter_frac(2) and occupancy >= 1.0:
+        level = 2
+      elif queue_frac >= self._enter_frac(1):
+        level = 1
+    if itl_over:
+      level = max(level, 1)
+    return level
+
+  def observe(self, queue_depth: int, occupancy: float,
+              itl_s: float = 0.0) -> int:
+    """Feed one engine iteration's load signals; returns the (possibly
+    new) degradation level.  Escalation is immediate; de-escalation one
+    level per call, and only once pressure is well clear (docstring)."""
+    queue_frac = (queue_depth / self.queue_limit
+                  if self.queue_limit > 0 else 0.0)
+    itl_over = bool(self.itl_slo_s > 0 and itl_s > self.itl_slo_s)
+    target = self._target_level(queue_frac, occupancy, itl_over)
+    new = self.level
+    if target > self.level:
+      new = target
+    elif target < self.level:
+      clear = queue_frac < 0.5 * self._enter_frac(self.level)
+      if self.level == 2 and occupancy < 1.0:
+        # budget_tight's entry condition includes full occupancy; once
+        # slots sit free the clamp only slows the admissions that drain
+        # the queue, so its release does not wait for queue hysteresis.
+        clear = True
+      # No extra ITL gate here: an over-SLO ITL floors `target` at 1
+      # (so the ladder never drops below spec_off while it holds), but
+      # it must not pin levels 2-3 — a stale EWMA on a drained engine
+      # (ITL only refreshes on decode steps, which a fully-shedding
+      # engine never runs) would otherwise hold the shed level forever.
+      if clear:
+        new = self.level - 1
+    if new != self.level:
+      old, self.level = self.level, new
+      self.transitions += 1
+      get_logger().info(
+          "serving degradation: %s -> %s (queue %d/%s, occupancy %.2f, "
+          "itl %.4fs vs slo %.4fs)", DEGRADE_LEVELS[old],
+          DEGRADE_LEVELS[new], queue_depth, self.queue_limit or "inf",
+          occupancy, itl_s, self.itl_slo_s)
+      if self.on_transition is not None:
+        self.on_transition(old, new, {
+            "queue_depth": int(queue_depth),
+            "occupancy": float(occupancy), "itl_s": float(itl_s)})
+    return self.level
+
+  # ------------------------------------------------------------ admission
+
+  def should_shed(self, queue_depth: int) -> bool:
+    """Submit-time verdict: shed when the bounded queue is full or the
+    ladder has reached its shed level.  Pure predicate — safe to poll
+    for introspection; the caller that actually sheds a request
+    records it via :meth:`note_shed`."""
+    if self.queue_limit > 0 and queue_depth >= self.queue_limit:
+      return True
+    return self.level >= 3
+
+  def note_shed(self):
+    """Count one actually-shed request (the engine's shed path calls
+    this after acting on a True :meth:`should_shed` verdict)."""
+    self.shed_total += 1
+
+  @property
+  def speculation_enabled(self) -> bool:
+    return self.level < 1
+
+  @property
+  def budget_tightened(self) -> bool:
+    return self.level >= 2
+
+
+class BadStepPolicy:
+  """Retry-then-quarantine policy over per-slot bad-step streaks.
+
+  The guarded fused step (engine.py) returns a per-slot finiteness
+  verdict; a bad slot's cursor and host state never advanced, so simply
+  replanning retries it exactly.  This class only decides WHEN to stop
+  retrying: a slot whose streak exceeds ``max_step_retries`` is
+  quarantined (requeue with committed prefix — a fresh slot's replay
+  rewrites any poisoned K/V), and a request requeued more than
+  ``max_requeues`` times is failed.
+  """
+
+  RETRY, REQUEUE, FAIL = "retry", "requeue", "fail"
+
+  def __init__(self, max_step_retries: int = 1, max_requeues: int = 1):
+    if max_step_retries < 0 or max_requeues < 0:
+      raise ValueError("max_step_retries and max_requeues must be >= 0")
+    self.max_step_retries = max_step_retries
+    self.max_requeues = max_requeues
+    self.bad_steps = 0        # engine steps with >= 1 bad slot
+    self.step_retries = 0     # slot-steps replayed in place
+    self.requeues = 0
+    self.failures = 0
+
+  def judge(self, slot_states: Dict[int, "object"],
+            bad_slots: List[int],
+            exercised: Optional[set] = None) -> Dict[int, str]:
+    """Update streaks for one engine step and return the action per bad
+    slot (``retry`` | ``requeue`` | ``fail``).  ``slot_states`` is the
+    scheduler's ``active`` map (entries carry ``bad_streak`` and
+    ``requeues``); good slots' streaks reset here — but only slots the
+    step actually EXERCISED (``exercised``, the plan's num_valid>0 set;
+    None = all): a budget-starved slot proved nothing this step, and
+    resetting its streak would re-grant a poisoned slot its full retry
+    allowance on every starvation interleave, postponing quarantine
+    indefinitely."""
+    if bad_slots:
+      self.bad_steps += 1
+    actions: Dict[int, str] = {}
+    bad = set(bad_slots)
+    for slot, state in slot_states.items():
+      if slot not in bad:
+        if exercised is None or slot in exercised:
+          state.bad_streak = 0
+        continue
+      state.bad_streak += 1
+      if state.bad_streak <= self.max_step_retries:
+        self.step_retries += 1
+        actions[slot] = self.RETRY
+      elif state.requeues < self.max_requeues:
+        self.requeues += 1
+        actions[slot] = self.REQUEUE
+      else:
+        self.failures += 1
+        actions[slot] = self.FAIL
+    return actions
+
+  def counters(self) -> Dict[str, int]:
+    return {"bad_steps": self.bad_steps,
+            "step_retries": self.step_retries,
+            "requeues": self.requeues,
+            "failed_requests": self.failures}

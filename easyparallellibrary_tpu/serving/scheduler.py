@@ -14,13 +14,26 @@ Responsibilities (and nothing else — device work lives in engine.py):
   bounds how much prompt work any single step may carry
   (Sarathi-style chunked prefill: long prompts stream through the fused
   step ``prefill_chunk`` tokens at a time, so admission never stalls
-  decode latency for more than one chunk).
+  decode latency for more than one chunk).  ``latency``-class requests
+  jump the FCFS order (:attr:`Request.priority`).
 * Per-request decode state: prompt cursor, generated tokens, per-request
   RNG stream (a dedicated PRNGKey folded with the token index — two
   requests with the same seed reproduce the same sample stream no
   matter which slots or iterations they ride).
 * Retirement: per-request ``max_new_tokens`` and optional stop-token,
-  plus the hard ``max_seq_len`` capacity guard (checked at submit).
+  plus the hard ``max_seq_len`` capacity guard (checked at submit), and
+  the lifecycle-control reasons — per-request deadlines / TTFT budgets
+  (``deadline``), client cancellation (``cancelled``), overload
+  rejection (``shed``, engine-side) and quarantine overflow
+  (``failed``).  The full glossary lives in
+  ``serving._capabilities.FINISH_REASONS`` / docs/robustness.md.
+* Requeue: :meth:`requeue_slot` returns a mid-flight request to the
+  FRONT of the queue with its committed prefix intact — on readmission
+  the prompt AND the already-generated tokens replay through chunked
+  prefill into a fresh slot, which reproduces the exact decode state
+  (same KV content, same cursors-as-committed-token-count, same
+  ``tok_index`` RNG fold), so a quarantined request's final output is
+  bit-identical to an undisturbed run.
 """
 
 from __future__ import annotations
@@ -29,12 +42,14 @@ import dataclasses
 import time
 import zlib
 from collections import deque
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from easyparallellibrary_tpu.observability import trace as trace_lib
+from easyparallellibrary_tpu.serving._capabilities import (
+    check_request_fields)
 
 
 def _slot_track(slot: int) -> str:
@@ -58,6 +73,15 @@ class Request:
   follows the engine (a drafter is configured or not), False opts this
   request out (it then keeps the engine's non-speculative sample stream
   bit-exactly), True is a no-op on an engine without a drafter.
+
+  Lifecycle control (docs/robustness.md "Serving resilience"):
+  ``deadline_s`` retires the request with reason ``"deadline"`` once
+  that many seconds have passed since submit, wherever it is (queued,
+  prefilling, decoding; partial output is returned).  ``ttft_budget_s``
+  is the stricter first-token bound: expire unless the first token was
+  produced within the budget.  Both are 0 = off.  ``priority`` is
+  ``"throughput"`` (FCFS) or ``"latency"`` (admitted ahead of queued
+  throughput-class requests).
   """
   uid: Any
   prompt: np.ndarray
@@ -68,6 +92,9 @@ class Request:
   stop_token: int = -1
   seed: Optional[int] = None
   speculative: Optional[bool] = None
+  deadline_s: float = 0.0
+  ttft_budget_s: float = 0.0
+  priority: str = "throughput"
 
 
 @dataclasses.dataclass
@@ -75,7 +102,7 @@ class FinishedRequest:
   uid: Any
   tokens: np.ndarray          # prompt + generated (stop token included)
   new_tokens: int
-  finish_reason: str          # "length" | "stop_token"
+  finish_reason: str          # serving._capabilities.FINISH_REASONS
 
 
 @dataclasses.dataclass
@@ -97,45 +124,109 @@ class StepPlan:
 
 
 class _SlotState:
-  """Host mirror of one occupied slot."""
+  """Host mirror of one occupied slot.
 
-  __slots__ = ("req", "slot", "prompt_pos", "generated", "key",
-               "admitted_at", "first_token_at")
+  ``prefix`` is what chunked prefill feeds: the prompt for a fresh
+  request, prompt + already-committed tokens for a requeued one (the
+  replay that reconstructs the slot's KV/cursor state exactly).
+  """
 
-  def __init__(self, req: Request, slot: int):
+  __slots__ = ("req", "slot", "prompt_pos", "generated", "key", "prefix",
+               "submitted_at", "admitted_at", "first_token_at",
+               "first_token_emitted", "requeues", "bad_streak")
+
+  def __init__(self, req: Request, slot: int, submitted_at: float,
+               now: float, carried: Optional["_SlotState"] = None):
     self.req = req
     self.slot = slot
-    self.prompt_pos = 0                    # prompt tokens already fed
-    self.generated: List[int] = []
-    if req.seed is not None:
-      seed = req.seed
+    self.prompt_pos = 0                    # prefix tokens already fed
+    self.submitted_at = submitted_at
+    self.admitted_at = now
+    self.bad_streak = 0                    # consecutive bad-step hits
+    if carried is not None:
+      self.generated: List[int] = carried.generated
+      self.key = carried.key
+      self.first_token_at = carried.first_token_at
+      self.first_token_emitted = carried.first_token_emitted
+      self.requeues = carried.requeues
+      self.prefix = np.concatenate(
+          [req.prompt, np.asarray(self.generated, np.int32)])
     else:
-      # Stable across processes (Python's hash() is salted per process,
-      # which would make a restarted server sample different streams
-      # for the same uid).
-      seed = zlib.crc32(str(req.uid).encode())
-    self.key = np.asarray(jax.random.PRNGKey(seed))
-    self.admitted_at = time.monotonic()
-    self.first_token_at: Optional[float] = None
+      self.generated = []
+      if req.seed is not None:
+        seed = req.seed
+      else:
+        # Stable across processes (Python's hash() is salted per
+        # process, which would make a restarted server sample different
+        # streams for the same uid).
+        seed = zlib.crc32(str(req.uid).encode())
+      self.key = np.asarray(jax.random.PRNGKey(seed))
+      self.first_token_at: Optional[float] = None
+      self.first_token_emitted = False
+      self.requeues = 0
+      self.prefix = req.prompt
 
   @property
   def prefilling(self) -> bool:
-    return self.prompt_pos < len(self.req.prompt)
+    return self.prompt_pos < len(self.prefix)
+
+
+class _Pending:
+  """Queue entry: a not-yet-admitted request, optionally carrying the
+  slot state of a requeued one (its committed prefix replays through
+  prefill on readmission)."""
+
+  __slots__ = ("req", "submitted_at", "carried")
+
+  def __init__(self, req: Request, submitted_at: float,
+               carried: Optional[_SlotState] = None):
+    self.req = req
+    self.submitted_at = submitted_at
+    self.carried = carried
+
+  @property
+  def prefix_len(self) -> int:
+    if self.carried is not None:
+      return len(self.req.prompt) + len(self.carried.generated)
+    return len(self.req.prompt)
+
+  # Read-through to the wrapped request, so queue introspection
+  # (`sched.pending[0].uid`) reads the same as before entries carried
+  # submit timestamps.
+  @property
+  def uid(self):
+    return self.req.uid
+
+  @property
+  def prompt(self):
+    return self.req.prompt
+
+  @property
+  def priority(self) -> str:
+    return self.req.priority
 
 
 class FCFSScheduler:
   """First-come-first-served continuous-batching scheduler.
 
-  ``plan_step()`` builds the next fused-step inputs (admitting new
-  requests as slots and budget allow); ``commit(next_tokens)`` folds the
-  step's sampled tokens back into per-request state and returns the
-  requests that retired.  The engine owns the device half of the loop.
+  ``plan_step()`` builds the next fused-step inputs (expiring dead
+  requests, then admitting new ones as slots and budget allow);
+  ``commit(next_tokens)`` folds the step's sampled tokens back into
+  per-request state and returns the requests that retired.  The engine
+  owns the device half of the loop.
+
+  The ``on_admit`` / ``on_first_token`` / ``on_finish`` hooks are LISTS
+  of subscribers (append, don't assign) so stats, resilience and user
+  callbacks compose without clobbering each other.
+
+  ``clock`` is injectable for deterministic deadline tests; production
+  callers leave it at ``time.monotonic``.
   """
 
   def __init__(self, num_slots: int, prefill_chunk: int,
                max_seq_len: int, prefill_token_budget: int = 0,
                max_batch: int = 0, stop_token: int = -1,
-               spec_k: int = 0):
+               spec_k: int = 0, clock: Callable[[], float] = time.monotonic):
     from easyparallellibrary_tpu.serving.kv_cache import SlotAllocator
     if prefill_chunk < 1:
       raise ValueError(f"prefill_chunk must be >= 1: {prefill_chunk}")
@@ -147,26 +238,54 @@ class FCFSScheduler:
     self.chunk = prefill_chunk
     self.max_seq_len = max_seq_len
     # Max speculative drafts per decode slot per step (0 = engine has no
-    # drafter); per-request Request.speculative=False opts out.
+    # drafter); per-request Request.speculative=False opts out, and the
+    # engine's degradation ladder flips `spec_enabled` off under load.
     self.spec_k = spec_k
+    self.spec_enabled = True
     # 0 = uncapped: every prefilling slot gets a full chunk each step.
     self.prefill_token_budget = prefill_token_budget
+    # Temporary degradation override (engine resilience): when > 0 the
+    # effective per-step budget is min(budget or inf, override).
+    self.budget_override = 0
     self.max_batch = max_batch if max_batch > 0 else num_slots
     self.default_stop_token = stop_token
+    self.clock = clock
     self.allocator = SlotAllocator(num_slots)
-    self.pending: Deque[Request] = deque()
+    self.pending: Deque[_Pending] = deque()
+    # Count of queued latency-class entries, maintained at every
+    # pending mutation: _next_pending_index early-outs to O(1) FCFS
+    # when none is queued (the common case — an overload queue of
+    # throughput requests must not pay an O(depth) scan per admission).
+    self._latency_pending = 0
+    # Same O(1) discipline for lifecycle deadlines: counts of queued /
+    # active requests carrying a deadline or TTFT budget, so expire()
+    # (called every plan_step) skips its queue scan and active-slot
+    # sweep outright when no request has one — the default.
+    self._deadline_pending = 0
+    self._deadline_active = 0
     self.active: Dict[int, _SlotState] = {}   # slot -> state
     self._admit_order: List[int] = []         # slots, admission order
     self._plan: Optional[StepPlan] = None
-    self.on_admit = None                      # hooks: fn(uid)
-    self.on_first_token = None                # fn(uid)
-    self.on_finish = None                     # fn(FinishedRequest)
+    self._finished_buffer: List[FinishedRequest] = []
+    self.on_admit: List[Callable[[Any], None]] = []      # fn(uid)
+    self.on_first_token: List[Callable[[Any], None]] = []  # fn(uid)
+    self.on_finish: List[Callable[[FinishedRequest], None]] = []
+
+  def _effective_budget(self) -> int:
+    if self.budget_override > 0:
+      if self.prefill_token_budget > 0:
+        return min(self.prefill_token_budget, self.budget_override)
+      return self.budget_override
+    return self.prefill_token_budget
 
   # ---------------------------------------------------------------- queue
 
-  def submit(self, req: Request):
-    """Validate and enqueue (FCFS).  Mirrors ``generate()``'s argument
-    validation so a request the engine accepts can always run."""
+  def validate(self, req: Request) -> np.ndarray:
+    """Raise on a malformed request (mirrors ``generate()``'s argument
+    validation so a request the engine accepts can always run); returns
+    the normalized prompt.  The engine also calls this BEFORE its shed
+    verdict, so a malformed request fails loudly regardless of load
+    instead of being silently recorded as ``"shed"``."""
     prompt = np.asarray(req.prompt, np.int32).reshape(-1)
     if prompt.size == 0:
       raise ValueError("request needs a non-empty prompt (at least a BOS "
@@ -181,10 +300,20 @@ class FCFSScheduler:
       raise ValueError(f"top_p must be in (0, 1]: {req.top_p}")
     if req.top_k < 0:
       raise ValueError(f"top_k must be >= 0: {req.top_k}")
+    check_request_fields(req)
+    return prompt
+
+  def submit(self, req: Request, _prompt: Optional[np.ndarray] = None):
+    """Validate and enqueue (FCFS).  ``_prompt`` lets the engine pass
+    the normalized prompt from its own pre-shed ``validate`` call so an
+    accepted submit validates exactly once."""
+    prompt = self.validate(req) if _prompt is None else _prompt
     req = dataclasses.replace(req, prompt=prompt)
     if req.stop_token < 0 and self.default_stop_token >= 0:
       req = dataclasses.replace(req, stop_token=self.default_stop_token)
-    self.pending.append(req)
+    self.pending.append(_Pending(req, self.clock()))
+    self._latency_pending += req.priority == "latency"
+    self._deadline_pending += self._has_deadline(req)
     tracer = trace_lib.get_tracer()
     if tracer.enabled:  # args dicts are not free; skip them when off
       tracer.instant(
@@ -200,29 +329,188 @@ class FCFSScheduler:
   def num_active(self) -> int:
     return len(self.active)
 
+  @property
+  def queue_depth(self) -> int:
+    return len(self.pending)
+
+  def take_finished(self) -> List[FinishedRequest]:
+    """Drain retirements accumulated since the last call (commit-time
+    retirements plus plan-time expiries and out-of-band cancellations)."""
+    out, self._finished_buffer = self._finished_buffer, []
+    return out
+
+  # ------------------------------------------------------ lifecycle ctl
+
+  def _finish_unadmitted(self, entry: _Pending, reason: str):
+    """Retire a request straight out of the queue (expiry/cancel before
+    a slot was ever granted — or after a requeue)."""
+    generated = (entry.carried.generated if entry.carried is not None
+                 else [])
+    fin = FinishedRequest(
+        uid=entry.req.uid,
+        tokens=np.concatenate(
+            [entry.req.prompt, np.asarray(generated, np.int32)]),
+        new_tokens=len(generated),
+        finish_reason=reason)
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.instant(
+          f"serving/{reason}", cat="serving", track="serving/requests",
+          args={"uid": str(entry.req.uid), "where": "queue"})
+    self._finished_buffer.append(fin)
+    for fn in self.on_finish:
+      fn(fin)
+    return fin
+
+  @staticmethod
+  def _has_deadline(req: Request) -> bool:
+    return req.deadline_s > 0 or req.ttft_budget_s > 0
+
+  def _expired(self, req: Request, submitted_at: float, now: float,
+               first_token: bool) -> bool:
+    waited = now - submitted_at
+    if req.deadline_s > 0 and waited >= req.deadline_s:
+      return True
+    return (req.ttft_budget_s > 0 and not first_token
+            and waited >= req.ttft_budget_s)
+
+  def expire(self, now: Optional[float] = None) -> int:
+    """Retire every queued or active request whose deadline / TTFT
+    budget has passed (finish reason ``"deadline"``).  Called by
+    ``plan_step`` each iteration; callable standalone.  O(1) when no
+    queued/active request carries a deadline (the ``_deadline_*``
+    counters).  Returns how many requests expired."""
+    now = self.clock() if now is None else now
+    expired = 0
+    if self.pending and self._deadline_pending:
+      keep: Deque[_Pending] = deque()
+      for entry in self.pending:
+        first = (entry.carried.first_token_emitted
+                 if entry.carried is not None else False)
+        if self._expired(entry.req, entry.submitted_at, now, first):
+          # _expired is True only for a deadline-carrying request, so
+          # the unconditional decrement is exact.
+          self._latency_pending -= entry.req.priority == "latency"
+          self._deadline_pending -= 1
+          self._finish_unadmitted(entry, "deadline")
+          expired += 1
+        else:
+          keep.append(entry)
+      self.pending = keep
+    if not self._deadline_active:
+      return expired
+    for slot in list(self._admit_order):
+      state = self.active.get(slot)
+      if state is None:
+        continue
+      if self._expired(state.req, state.submitted_at, now,
+                       state.first_token_emitted):
+        self._retire(state, "deadline")
+        expired += 1
+    return expired
+
+  def cancel(self, uid: Any) -> bool:
+    """Client cancellation: retire `uid` wherever it is (queued or
+    active) with finish reason ``"cancelled"``.  Returns False when the
+    request is unknown (already finished, or never submitted)."""
+    for i, entry in enumerate(self.pending):
+      if entry.req.uid == uid:
+        del self.pending[i]
+        self._latency_pending -= entry.req.priority == "latency"
+        self._deadline_pending -= self._has_deadline(entry.req)
+        self._finish_unadmitted(entry, "cancelled")
+        return True
+    for slot, state in list(self.active.items()):
+      if state.req.uid == uid:
+        self._retire(state, "cancelled")
+        return True
+    return False
+
+  def requeue_slot(self, slot: int, reason: str = "bad_step"
+                   ) -> Optional[Any]:
+    """Quarantine: evict `slot`'s request back to the FRONT of the queue
+    with its committed prefix intact (module docstring) — the engine's
+    bad-step recovery uses this to stop one poisoned slot from wedging
+    the batch.  Returns the requeued uid, or None for an empty slot."""
+    state = self.active.get(slot)
+    if state is None:
+      return None
+    del self.active[slot]
+    self._admit_order.remove(slot)
+    self.allocator.free(slot)
+    self._deadline_active -= self._has_deadline(state.req)
+    state.requeues += 1
+    state.bad_streak = 0
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.end(
+          f"request {state.req.uid}", cat="serving.request",
+          track=_slot_track(slot),
+          args={"finish_reason": "requeued",
+                "new_tokens": int(len(state.generated))})
+      tracer.instant(
+          "serving/requeue", cat="serving", track="serving/requests",
+          args={"uid": str(state.req.uid), "slot": int(slot),
+                "reason": reason,
+                "committed_prefix": int(len(state.req.prompt)
+                                        + len(state.generated))})
+    self.pending.appendleft(
+        _Pending(state.req, state.submitted_at, carried=state))
+    self._latency_pending += state.req.priority == "latency"
+    self._deadline_pending += self._has_deadline(state.req)
+    return state.req.uid
+
+  def retire_slot(self, slot: int, reason: str) -> Optional[FinishedRequest]:
+    """Force-retire an active slot with an explicit finish reason (the
+    engine's quarantine-overflow path: reason ``"failed"``)."""
+    state = self.active.get(slot)
+    if state is None:
+      return None
+    return self._retire(state, reason)
+
   # ----------------------------------------------------------------- plan
 
+  def _next_pending_index(self) -> int:
+    """Admission order: the oldest ``latency``-class request if any is
+    queued (priority admission), else the queue head (FCFS).  O(1)
+    unless a latency-class entry is actually queued."""
+    if self._latency_pending == 0:
+      return 0
+    for i, entry in enumerate(self.pending):
+      if entry.req.priority == "latency":
+        return i
+    return 0
+
   def _admit(self) -> None:
-    """Admit pending requests FCFS while slots, the batch cap and the
-    prefill budget allow.  The budget is charged for each admission's
-    first chunk so one step never admits more prefill work than it can
-    schedule — an admitted-but-starved request would hold a slot while
-    contributing nothing."""
-    budget_left = self.prefill_token_budget
+    """Admit pending requests while slots, the batch cap and the prefill
+    budget allow — ``latency``-class first, then FCFS.  The budget is
+    charged for each admission's first chunk so one step never admits
+    more prefill work than it can schedule — an admitted-but-starved
+    request would hold a slot while contributing nothing."""
+    budget_cap = self._effective_budget()
+    budget_left = budget_cap
     if budget_left > 0:
       # Already-active prefill slots have first claim on the budget.
       budget_left -= sum(
-          min(self.chunk, len(s.req.prompt) - s.prompt_pos)
+          min(self.chunk, len(s.prefix) - s.prompt_pos)
           for s in self.active.values() if s.prefilling)
     while (self.pending and self.allocator.num_free > 0
            and len(self.active) < self.max_batch):
-      first_chunk = min(self.chunk, len(self.pending[0].prompt))
-      if self.prefill_token_budget > 0 and budget_left < first_chunk:
+      idx = self._next_pending_index()
+      entry = self.pending[idx]
+      first_chunk = min(self.chunk, entry.prefix_len)
+      if budget_cap > 0 and budget_left < first_chunk:
         break
       budget_left -= first_chunk
-      req = self.pending.popleft()
+      del self.pending[idx]
+      self._latency_pending -= entry.req.priority == "latency"
+      self._deadline_pending -= self._has_deadline(entry.req)
+      req = entry.req
       slot = self.allocator.alloc()
-      self.active[slot] = _SlotState(req, slot)
+      state = _SlotState(req, slot, entry.submitted_at, self.clock(),
+                         carried=entry.carried)
+      self.active[slot] = state
+      self._deadline_active += self._has_deadline(req)
       self._admit_order.append(slot)
       # The request's lifecycle span opens on its slot's track and stays
       # open until _retire — every per-step prefill/decode span the
@@ -230,24 +518,28 @@ class FCFSScheduler:
       # track row reads as the request's complete timeline.
       tracer = trace_lib.get_tracer()
       if tracer.enabled:
-        tracer.begin(
-            f"request {req.uid}", cat="serving.request",
-            track=_slot_track(slot),
-            args={"uid": str(req.uid),
-                  "prompt_tokens": int(len(req.prompt)),
-                  "max_new_tokens": int(req.max_new_tokens)})
-      if self.on_admit:
-        self.on_admit(req.uid)
+        args = {"uid": str(req.uid),
+                "prompt_tokens": int(len(req.prompt)),
+                "max_new_tokens": int(req.max_new_tokens)}
+        if state.requeues:
+          args["requeues"] = int(state.requeues)
+        tracer.begin(f"request {req.uid}", cat="serving.request",
+                     track=_slot_track(slot), args=args)
+      if state.requeues == 0:
+        for fn in self.on_admit:
+          fn(req.uid)
 
   def plan_step(self) -> Optional[StepPlan]:
     """Build the next fused step's inputs, or None when idle.
 
-    Budgeting: decode slots always get their one token (decode latency
-    is the metric continuous batching protects); prefill chunks are
-    granted FCFS in admission order until the per-step budget runs out —
-    a starved prefill slot simply carries ``num_valid=0`` this step and
-    resumes next step.
+    Order: expire dead requests, admit (priority first, then FCFS),
+    then grant tokens.  Budgeting: decode slots always get their one
+    token (decode latency is the metric continuous batching protects);
+    prefill chunks are granted FCFS in admission order until the
+    per-step budget runs out — a starved prefill slot simply carries
+    ``num_valid=0`` this step and resumes next step.
     """
+    self.expire()
     self._admit()
     if not self.active:
       self._plan = None
@@ -266,7 +558,7 @@ class FCFSScheduler:
         prefilling=np.zeros((N,), bool),
         prefill_tokens=0, decode_tokens=0,
         active_slots=len(self.active))
-    budget = self.prefill_token_budget
+    budget = self._effective_budget()
     for slot in self._admit_order:
       state = self.active.get(slot)
       if state is None:
@@ -277,15 +569,17 @@ class FCFSScheduler:
       plan.temperature[slot] = req.temperature
       plan.top_k[slot] = req.top_k
       plan.top_p[slot] = req.top_p
-      plan.reset[slot] = state.prompt_pos == 0 and not state.generated
+      # Nothing fed yet (fresh slot, or a requeued request starting its
+      # replay): zero the cursor before this step's writes.
+      plan.reset[slot] = state.prompt_pos == 0
       if state.prefilling:
-        remaining = len(req.prompt) - state.prompt_pos
+        remaining = len(state.prefix) - state.prompt_pos
         grant = min(C, remaining)
         if budget > 0:
           grant = min(grant, max(budget - plan.prefill_tokens, 0))
         if grant == 0:
           continue  # budget-starved this step; resumes next step
-        chunk = req.prompt[state.prompt_pos:state.prompt_pos + grant]
+        chunk = state.prefix[state.prompt_pos:state.prompt_pos + grant]
         plan.tokens[slot, :grant] = chunk
         plan.num_valid[slot] = grant
         plan.prefilling[slot] = True
@@ -294,7 +588,8 @@ class FCFSScheduler:
         plan.tokens[slot, 0] = state.generated[-1]
         plan.num_valid[slot] = 1
         plan.decode_tokens += 1
-        if self.spec_k > 0 and req.speculative is not False:
+        if (self.spec_k > 0 and self.spec_enabled
+            and req.speculative is not False):
           # Drafting past the request's remaining budget is pure waste:
           # at most (remaining - 1) drafts can commit alongside the
           # step's guaranteed token.
@@ -321,6 +616,7 @@ class FCFSScheduler:
     del self.active[slot]
     self._admit_order.remove(slot)
     self.allocator.free(slot)
+    self._deadline_active -= self._has_deadline(state.req)
     tracer = trace_lib.get_tracer()
     if tracer.enabled:
       tracer.end(
@@ -335,18 +631,25 @@ class FCFSScheduler:
              np.asarray(state.generated, np.int32)]),
         new_tokens=len(state.generated),
         finish_reason=reason)
-    if self.on_finish:
-      self.on_finish(fin)
+    self._finished_buffer.append(fin)
+    for fn in self.on_finish:
+      fn(fin)
     return fin
 
   def commit(self, next_tokens: np.ndarray,
-             num_committed: Optional[np.ndarray] = None
+             num_committed: Optional[np.ndarray] = None,
+             slot_ok: Optional[np.ndarray] = None
              ) -> List[FinishedRequest]:
     """Fold one step's committed tokens back into request state; returns
-    retirements.  ``next_tokens`` is ``[N]`` (one sampled token per
-    slot, the non-speculative step) or ``[N, K+1]`` with
+    this iteration's retirements (commit-time plus any buffered
+    plan-time expiries).  ``next_tokens`` is ``[N]`` (one sampled token
+    per slot, the non-speculative step) or ``[N, K+1]`` with
     ``num_committed [N]`` (speculative verification: accepted drafts
-    plus the correction/bonus token).  A slot's tokens only count when
+    plus the correction/bonus token).  ``slot_ok`` (bool [N], engine
+    resilience) marks slots whose device step was judged bad — those are
+    skipped WHOLESALE (no prefix advance, no token commit), which makes
+    the next ``plan_step`` re-feed the identical work: the cursor never
+    moved, so the replay is the retry.  A slot's tokens only count when
     its prompt is fully consumed — mid-prefill samples are positions
     whose "next token" is still dictated by the prompt.  Multi-token
     commits apply stop-token and ``max_new_tokens`` checks PER TOKEN in
@@ -360,32 +663,39 @@ class FCFSScheduler:
       tokens = tokens[:, None]
     if num_committed is None:
       num_committed = np.ones((tokens.shape[0],), np.int32)
-    finished: List[FinishedRequest] = []
-    now = time.monotonic()
+    now = self.clock()
     for slot in list(self._admit_order):
       state = self.active.get(slot)
       if state is None or plan.num_valid[slot] == 0:
         continue
+      if slot_ok is not None and not slot_ok[slot]:
+        continue  # bad step: state untouched — next plan retries exactly
       req = state.req
       if state.prefilling:
         state.prompt_pos += int(plan.num_valid[slot])
         if state.prefilling:
           continue  # more prompt to feed; discard the sample
-        state.first_token_at = now
-        tracer = trace_lib.get_tracer()
-        if tracer.enabled:
-          tracer.instant(
-              "serving/first_token", cat="serving",
-              track=_slot_track(slot), args={"uid": str(req.uid)})
-        if self.on_first_token:
-          self.on_first_token(req.uid)
+        if not state.first_token_emitted:
+          state.first_token_emitted = True
+          state.first_token_at = now
+          tracer = trace_lib.get_tracer()
+          if tracer.enabled:
+            tracer.instant(
+                "serving/first_token", cat="serving",
+                track=_slot_track(slot), args={"uid": str(req.uid)})
+          for fn in self.on_first_token:
+            fn(req.uid)
+        # A requeued replay commits this sample too: the last prefix
+        # position's logits ARE the distribution for new token number
+        # len(generated) — identical to the undisturbed decode step
+        # (tok_index fold included), so the stream continues bit-exactly.
       for j in range(int(num_committed[slot])):
         tok = int(tokens[slot, j])
         state.generated.append(tok)
         if req.stop_token >= 0 and tok == req.stop_token:
-          finished.append(self._retire(state, "stop_token"))
+          self._retire(state, "stop_token")
           break
         if len(state.generated) >= req.max_new_tokens:
-          finished.append(self._retire(state, "length"))
+          self._retire(state, "length")
           break
-    return finished
+    return self.take_finished()

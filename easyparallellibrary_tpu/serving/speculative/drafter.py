@@ -93,6 +93,15 @@ class Drafter:
     post-rollback cursor vector (committed cache-resident tokens per
     slot)."""
 
+  def observe_skip(self, plan) -> None:
+    """Engine hook when a step runs WITHOUT drafting (the resilience
+    degradation ladder's spec_off level skips draft compute outright —
+    serving/resilience.py).  Stateless drafters ignore it; drafters
+    with device state may mark their mirror stale.  Skipping can only
+    cost acceptance rate after recovery, never correctness: the
+    verifier judges every later draft against the target's own
+    distribution."""
+
 
 class NgramDrafter(Drafter):
   """Model-free prompt-lookup drafter (:func:`ngram_propose` per slot).
@@ -245,3 +254,12 @@ class DraftModelDrafter(Drafter):
     # vector IS the draft-side rollback (rejected-draft K/V beyond it is
     # masked, then overwritten, exactly like chunked-prefill garbage).
     self._cursors = new_cursors
+
+  def observe_skip(self, plan):
+    # A skipped step (resilience spec_off window) means the mirror cache
+    # missed this step's K/V writes: positions the engine committed
+    # during the window hold garbage on the draft side until the slot is
+    # reused.  That can only depress acceptance after recovery — the
+    # target's verification still judges every draft — so no repair pass
+    # is attempted on the serving hot path.
+    pass

@@ -15,14 +15,31 @@ exercise the real recovery paths instead of mocking them:
 * preemption — :class:`SigtermInjector` (deliver SIGTERM to the current
   process mid-`fit`, from inside the data stream).
 
-These mutate real files and deliver real signals; none of them are
-imported by library code.
+Serving-side faults (`tests/test_serving_resilience.py`, `make
+chaos-serve`) — the adversaries of serving/resilience.py:
+
+* NaN logits — :class:`NaNLogitsInjector` wraps a serving engine's
+  fused step and swaps in fully-NaN params for chosen device calls, so
+  the model GENUINELY produces non-finite logits (the in-jit finiteness
+  verdict sees the real thing, not a mock) with identical
+  shapes/dtypes/shardings — no recompile;
+* hung steps — :class:`HangingStepInjector` (sleep before chosen
+  dispatches, tripping the serving watchdog);
+* flaky drafters — :class:`FlakyDrafter` (a Drafter wrapper raising or
+  proposing garbage on chosen calls — the engine must degrade, and
+  verification must keep outputs exact);
+* overload — :func:`poisson_trace` (Poisson arrival offsets for
+  admission-control / shedding episodes).
+
+These mutate real files, deliver real signals and poison real device
+calls; none of them are imported by library code.
 """
 
 from __future__ import annotations
 
 import os
 import signal as _signal
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import jax
@@ -211,3 +228,159 @@ class SigtermInjector:
       os.kill(os.getpid(), _signal.SIGTERM)
     self._drawn += 1
     return self.batch
+
+
+# ------------------------------------------------------- serving faults --
+
+
+class _StepFnWrapper:
+  """Base for fused-step interceptors: installs itself over
+  ``engine._step_fn``, counts device calls, and forwards compile-cache
+  introspection so the chaos tests' ``_cache_size() == 1`` acceptance
+  assertions see THROUGH the wrapper to the one jitted program."""
+
+  def __init__(self, engine):
+    self.engine = engine
+    self.inner = engine._step_fn
+    self.calls = 0
+    engine._step_fn = self
+
+  def _cache_size(self) -> int:
+    return self.inner._cache_size()
+
+  def uninstall(self):
+    self.engine._step_fn = self.inner
+
+
+class NaNLogitsInjector(_StepFnWrapper):
+  """Poison chosen fused-step calls so the model GENUINELY computes
+  non-finite logits — the in-jit finiteness verdict judges real device
+  output, not a mock.
+
+  Mechanism: for device-call indices in `bad_calls` (0-based, counting
+  every fused-step dispatch), the params argument is swapped for a
+  fully-NaN copy with identical tree structure, shapes, dtypes and
+  shardings (each floating leaf times NaN — an eager elementwise op
+  preserves placement), so the ONE compiled step is reused — a
+  recompile would void the engine's compile-once contract mid-chaos.
+  A retry of the poisoned work arrives as a LATER call index and sees
+  clean params, modeling a transient device/memory fault; list an index
+  twice-adjacent (e.g. ``(3, 4)``) to model a persistent one that must
+  escalate from retry to quarantine."""
+
+  def __init__(self, engine, bad_calls: Sequence[int]):
+    super().__init__(engine)
+    self.bad_calls = set(bad_calls)
+    self.poisoned: list = []
+    self._nan_params = None
+
+  def _poison(self, params):
+    if self._nan_params is None:
+      nan = np.float32(np.nan)
+
+      def leaf(x):
+        if np.issubdtype(np.dtype(x.dtype), np.floating):
+          return x * nan
+        return x
+
+      self._nan_params = jax.tree_util.tree_map(leaf, params)
+    return self._nan_params
+
+  def __call__(self, params, *args):
+    call, self.calls = self.calls, self.calls + 1
+    if call in self.bad_calls:
+      self.poisoned.append(call)
+      params = self._poison(params)
+    return self.inner(params, *args)
+
+
+class HangingStepInjector(_StepFnWrapper):
+  """Stall chosen fused-step dispatches by ``hang_s`` of host sleep —
+  from the engine's point of view the device call went silent, which is
+  exactly what the serving watchdog (``serving.resilience.
+  step_timeout_s``) exists to surface.  The step then completes
+  normally: a hang is a latency fault, not a correctness fault, and
+  outputs must stay exact through it."""
+
+  def __init__(self, engine, hang_calls: Sequence[int],
+               hang_s: float = 0.05):
+    super().__init__(engine)
+    self.hang_calls = set(hang_calls)
+    self.hang_s = hang_s
+    self.hangs = 0
+
+  def __call__(self, params, *args):
+    call, self.calls = self.calls, self.calls + 1
+    if call in self.hang_calls:
+      self.hangs += 1
+      time.sleep(self.hang_s)
+    return self.inner(params, *args)
+
+
+class FlakyDrafter:
+  """Drafter wrapper that raises (``mode="raise"``) or proposes
+  uniformly random garbage (``mode="garbage"``) on chosen ``propose``
+  calls — the two ways a real drafter fails.  The engine must degrade
+  a raising drafter to zero drafts for the step, and verification must
+  reject garbage proposals; either way committed output stays exact
+  (a flaky drafter may cost speed, never correctness)."""
+
+  def __init__(self, inner, bad_calls: Sequence[int],
+               mode: str = "raise", seed: int = 0):
+    if mode not in ("raise", "garbage"):
+      raise ValueError(f"unknown FlakyDrafter mode {mode!r}")
+    self.inner = inner
+    self.bad_calls = set(bad_calls)
+    self.mode = mode
+    self.calls = 0
+    self.faults = 0
+    self._rng = np.random.RandomState(seed)
+    self._vocab: Optional[int] = None
+
+  @property
+  def k(self) -> int:
+    return self.inner.k
+
+  def bind(self, engine) -> None:
+    self._vocab = engine.model.cfg.vocab_size
+    self.inner.bind(engine)
+
+  def propose(self, plan, histories):
+    call, self.calls = self.calls, self.calls + 1
+    if call in self.bad_calls:
+      self.faults += 1
+      if self.mode == "raise":
+        raise RuntimeError("chaos: drafter failure")
+      N = plan.tokens.shape[0]
+      drafts = self._rng.randint(
+          0, self._vocab or 2, (N, self.k)).astype(np.int32)
+      return drafts, np.asarray(plan.draft_cap, np.int32)
+    return self.inner.propose(plan, histories)
+
+  def observe_commit(self, new_cursors) -> None:
+    self.inner.observe_commit(new_cursors)
+
+  def observe_skip(self, plan) -> None:
+    self.inner.observe_skip(plan)
+
+
+def poisson_trace(rate_per_s: float, n: int, seed: int = 0,
+                  rng: "np.random.RandomState" = None,
+                  first_at_zero: bool = True) -> np.ndarray:
+  """Arrival-time offsets (seconds, ascending) for `n` requests of a
+  Poisson process at `rate_per_s` — THE arrival model for every
+  overload/serving-throughput episode (benchmarks/decode_throughput.py
+  and serving_overload.py both draw from here, so the traffic shape
+  cannot silently diverge).  Pass ``rng`` to draw from an existing
+  generator (benchmarks thread one seeded stream through arrivals +
+  prompts + lengths); ``first_at_zero=False`` keeps the sampled first
+  gap (decode_throughput's historical trace — its BENCH_EVIDENCE
+  records stay seed-comparable across commits)."""
+  if rate_per_s <= 0:
+    raise ValueError(f"rate_per_s must be > 0: {rate_per_s}")
+  if rng is None:
+    rng = np.random.RandomState(seed)
+  gaps = rng.exponential(1.0 / rate_per_s, n)
+  if first_at_zero:
+    gaps[0] = 0.0
+  return np.cumsum(gaps)

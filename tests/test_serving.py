@@ -387,6 +387,27 @@ def test_serving_stats_rollup():
   assert percentile([], 99) == 0.0
 
 
+def test_serving_stats_finished_limit_windows_traces():
+  """serving.finished_limit: finished per-request traces evict
+  oldest-first (latency percentiles become a sliding window) while
+  aggregate counters keep the full history and in-flight traces are
+  never evicted."""
+  t = [0.0]
+  stats = ServingStats(clock=lambda: t[0], finished_limit=2)
+  for i, uid in enumerate(["a", "b", "c"]):
+    t[0] = float(i)
+    stats.note_submitted(uid)
+    stats.note_first_token(uid)
+    t[0] = float(i) + 0.5
+    stats.note_finished(uid, new_tokens=1)
+  stats.note_submitted("inflight")
+  assert stats.finished_requests == 3          # aggregates: full history
+  assert set(stats._req) == {"b", "c", "inflight"}  # traces: windowed
+  cfg = __import__("easyparallellibrary_tpu").Config
+  with pytest.raises(ValueError, match="finished_limit"):
+    cfg({"serving": {"finished_limit": -1}})
+
+
 # ------------------------------------------------------- pipeline fallback
 
 

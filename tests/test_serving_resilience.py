@@ -1,0 +1,609 @@
+"""Serving resilience: deadlines, admission control & shedding,
+bad-step recovery, and the serving chaos harness (ISSUE 6).
+
+The acceptance contract (`make chaos-serve`): under injected NaN steps,
+hung steps, flaky drafters and Poisson overload, every NON-SHED request
+finishes with greedy output bit-exact vs ``generate(use_cache=True)``,
+shed/expired requests carry the right finish reasons, and the fused
+step's compile count stays 1 across retries, degradation transitions
+and slot requeues.  The heavyweight chaos episodes are ``slow``-marked
+(tier-1 window budget — ROADMAP); ``make chaos-serve`` runs them all.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.models import GPT, GPTConfig
+from easyparallellibrary_tpu.models.gpt import generate
+from easyparallellibrary_tpu.serving import (
+    AdmissionController, BadStepPolicy, ContinuousBatchingEngine,
+    FCFSScheduler, Request)
+from easyparallellibrary_tpu.serving.speculative import NgramDrafter
+from easyparallellibrary_tpu.testing import chaos
+
+TINY = GPTConfig(vocab_size=64, num_layers=2, num_heads=4, d_model=32,
+                 d_ff=64, max_seq_len=32, dtype=jnp.float32)
+
+
+def _model_and_params(cfg=TINY, seed=0):
+  model = GPT(cfg)
+  params = model.init(jax.random.PRNGKey(seed),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+  return model, params
+
+
+def _prompts(lengths, vocab=64, seed=0):
+  r = np.random.RandomState(seed)
+  return [r.randint(0, vocab, (n,)).astype(np.int32) for n in lengths]
+
+
+def _oracle(model, params, prompt, max_new):
+  return np.asarray(
+      generate(model, params, jnp.asarray(prompt)[None], max_new))[0]
+
+
+def _res_config(**resilience):
+  resilience.setdefault("enabled", True)
+  return epl.Config({"serving": {"resilience": resilience}})
+
+
+class FakeClock:
+  """Injectable monotonic clock for deterministic deadline tests."""
+
+  def __init__(self, t: float = 0.0):
+    self.t = t
+
+  def __call__(self) -> float:
+    return self.t
+
+  def advance(self, dt: float):
+    self.t += dt
+
+
+def _sched(clock, num_slots=2, chunk=4, **kw):
+  return FCFSScheduler(num_slots=num_slots, prefill_chunk=chunk,
+                       max_seq_len=32, clock=clock, **kw)
+
+
+# ------------------------------------------------------------ policy units
+
+
+def test_admission_ladder_escalates_in_cost_order():
+  """Queue pressure walks the ladder normal -> spec_off -> budget_tight
+  -> shed; level 2 additionally requires full slot occupancy (tightening
+  the budget while slots sit empty would slow the draining admissions).
+  """
+  seen = []
+  ctl = AdmissionController(
+      queue_limit=8, degrade_queue_frac=0.5,
+      on_transition=lambda old, new, sig: seen.append((old, new)))
+  assert ctl.observe(1, 1.0) == 0
+  assert ctl.observe(4, 1.0) == 1           # frac 0.5 -> spec_off
+  assert ctl.speculation_enabled is False
+  assert ctl.observe(6, 0.5) == 1           # frac 0.75 but slots free
+  assert ctl.observe(6, 1.0) == 2           # full slots -> budget_tight
+  assert ctl.budget_tightened is True
+  assert ctl.observe(8, 1.0) == 3           # full queue -> shed
+  assert ctl.should_shed(8) is True
+  assert seen == [(0, 1), (1, 2), (2, 3)]
+  assert ctl.transitions == 3
+
+
+def test_admission_ladder_deescalates_with_hysteresis():
+  """De-escalation is one level per observation and only once the queue
+  has drained below HALF the level's entry threshold — a noisy boundary
+  cannot flap the ladder."""
+  ctl = AdmissionController(queue_limit=8, degrade_queue_frac=0.5)
+  ctl.observe(8, 1.0)
+  assert ctl.level == 3
+  assert ctl.observe(5, 1.0) == 3   # frac 0.625 >= 0.5 * enter(3): hold
+  assert ctl.observe(3, 1.0) == 2   # clear of 3, one level down
+  assert ctl.observe(0, 1.0) == 1   # one level per call, even at empty
+  assert ctl.observe(0, 1.0) == 0
+  # 0->3 escalation is ONE immediate transition; the descent is three.
+  assert ctl.transitions == 4
+
+
+def test_admission_itl_slo_forces_spec_off():
+  """A measured ITL above its SLO forces at least spec_off regardless of
+  queue depth (draft compute is the first ballast), and holds the level
+  until the ITL recovers."""
+  ctl = AdmissionController(queue_limit=8, itl_slo_s=0.01)
+  assert ctl.observe(0, 0.5, itl_s=0.05) == 1
+  assert ctl.speculation_enabled is False
+  assert ctl.observe(0, 0.5, itl_s=0.05) == 1   # still over: hold
+  assert ctl.observe(0, 0.5, itl_s=0.001) == 0
+  # An unbounded queue (queue_limit=0) still honors the ITL signal.
+  ctl = AdmissionController(queue_limit=0, itl_slo_s=0.01)
+  assert ctl.observe(100, 1.0, itl_s=0.0) == 0  # depth alone: no signal
+  assert ctl.observe(0, 0.0, itl_s=0.02) == 1
+
+
+def test_admission_sheds_on_full_queue_before_ladder():
+  ctl = AdmissionController(queue_limit=2)
+  assert ctl.should_shed(1) is False
+  # Pure predicate: polling it never inflates the shed counter; the
+  # caller that acts on the verdict records the shed explicitly.
+  assert ctl.should_shed(2) is True
+  assert ctl.should_shed(2) is True
+  assert ctl.shed_total == 0
+  ctl.note_shed()
+  assert ctl.shed_total == 1
+
+
+def test_bad_step_policy_retry_then_requeue_then_fail():
+  class S:  # the two fields judge() reads off scheduler slot state
+    def __init__(self):
+      self.bad_streak = 0
+      self.requeues = 0
+
+  pol = BadStepPolicy(max_step_retries=1, max_requeues=1)
+  slots = {0: S(), 1: S()}
+  assert pol.judge(slots, [0]) == {0: "retry"}        # streak 1: retry
+  assert pol.judge(slots, [0]) == {0: "requeue"}      # streak 2: out
+  slots[0].requeues = 1                                # scheduler did it
+  slots[0].bad_streak = 0
+  assert pol.judge(slots, [0]) == {0: "retry"}        # fresh slot life
+  assert pol.judge(slots, [0]) == {0: "fail"}         # requeues spent
+  assert pol.judge(slots, []) == {}                   # good step resets
+  assert slots[1].bad_streak == 0
+  assert pol.counters() == {"bad_steps": 4, "step_retries": 2,
+                            "requeues": 1, "failed_requests": 1}
+
+
+def test_request_lifecycle_field_validation():
+  clock = FakeClock()
+  sched = _sched(clock)
+  (p,) = _prompts((3,))
+  with pytest.raises(ValueError, match="priority"):
+    sched.submit(Request(uid=0, prompt=p, max_new_tokens=2,
+                         priority="realtime"))
+  with pytest.raises(ValueError, match="deadline_s"):
+    sched.submit(Request(uid=0, prompt=p, max_new_tokens=2,
+                         deadline_s=-1.0))
+  with pytest.raises(ValueError, match="ttft_budget_s"):
+    sched.submit(Request(uid=0, prompt=p, max_new_tokens=2,
+                         deadline_s=1.0, ttft_budget_s=2.0))
+
+
+def test_resilience_config_validation():
+  with pytest.raises(ValueError, match="queue_limit"):
+    _res_config(queue_limit=-1)
+  with pytest.raises(ValueError, match="degrade_queue_frac"):
+    _res_config(degrade_queue_frac=1.5)
+  with pytest.raises(ValueError, match="step_timeout_s"):
+    _res_config(step_timeout_s=-0.1)
+  with pytest.raises(ValueError, match="max_step_retries"):
+    _res_config(max_step_retries=-1)
+
+
+# ------------------------------------------- scheduler lifecycle control
+
+
+def test_deadline_expires_queued_request():
+  clock = FakeClock()
+  sched = _sched(clock, num_slots=1)
+  a, b = _prompts((3, 3))
+  sched.submit(Request(uid="a", prompt=a, max_new_tokens=4))
+  sched.submit(Request(uid="b", prompt=b, max_new_tokens=4,
+                       deadline_s=5.0))
+  sched.plan_step()          # slot goes to "a"; "b" waits in queue
+  clock.advance(6.0)
+  sched.plan_step()
+  fins = {f.uid: f for f in sched.take_finished()}
+  assert fins["b"].finish_reason == "deadline"
+  assert fins["b"].new_tokens == 0
+  np.testing.assert_array_equal(fins["b"].tokens, b)   # prompt returned
+  assert "a" not in fins                               # no deadline set
+
+
+def test_deadline_expires_active_request_with_partial_output():
+  clock = FakeClock()
+  sched = _sched(clock, num_slots=1)
+  (p,) = _prompts((3,))
+  sched.submit(Request(uid="a", prompt=p, max_new_tokens=8,
+                       deadline_s=10.0))
+  sched.plan_step()
+  sched.commit(np.asarray([7, 0], np.int32))   # prefill done: 1 token
+  clock.advance(11.0)
+  assert sched.plan_step() is None
+  (fin,) = sched.take_finished()
+  assert fin.finish_reason == "deadline" and fin.new_tokens == 1
+  np.testing.assert_array_equal(fin.tokens, list(p) + [7])
+
+
+def test_ttft_budget_only_binds_before_first_token():
+  clock = FakeClock()
+  sched = _sched(clock, num_slots=2)
+  a, b = _prompts((3, 3))
+  # "slow" never gets scheduled tokens before its TTFT budget passes.
+  sched.submit(Request(uid="slow", prompt=a, max_new_tokens=8,
+                       ttft_budget_s=1.0))
+  sched.submit(Request(uid="fast", prompt=b, max_new_tokens=8,
+                       ttft_budget_s=5.0))
+  sched.plan_step()
+  sched.commit(np.asarray([3, 3], np.int32))   # both emit first token
+  clock.advance(2.0)                           # past "slow"'s budget...
+  sched.plan_step()
+  assert not sched.take_finished()             # ...but token was in time
+  clock.advance(10.0)                          # past both budgets: moot
+  sched.plan_step()
+  assert not sched.take_finished()
+
+
+def test_ttft_budget_expires_unserved_request():
+  clock = FakeClock()
+  sched = _sched(clock, num_slots=1)
+  a, b = _prompts((3, 3))
+  sched.submit(Request(uid="a", prompt=a, max_new_tokens=8))
+  sched.submit(Request(uid="b", prompt=b, max_new_tokens=8,
+                       ttft_budget_s=1.0))     # stuck behind "a"
+  sched.plan_step()
+  clock.advance(1.5)
+  sched.plan_step()
+  (fin,) = sched.take_finished()
+  assert fin.uid == "b" and fin.finish_reason == "deadline"
+
+
+def test_cancel_queued_and_active():
+  clock = FakeClock()
+  sched = _sched(clock, num_slots=1)
+  a, b = _prompts((3, 3))
+  sched.submit(Request(uid="a", prompt=a, max_new_tokens=8))
+  sched.submit(Request(uid="b", prompt=b, max_new_tokens=8))
+  sched.plan_step()
+  sched.commit(np.asarray([5, 0], np.int32))
+  assert sched.cancel("b") is True             # still queued
+  assert sched.cancel("a") is True             # active, 1 token in
+  assert sched.cancel("ghost") is False        # unknown uid
+  fins = {f.uid: f for f in sched.take_finished()}
+  assert fins["b"].finish_reason == "cancelled"
+  assert fins["b"].new_tokens == 0
+  assert fins["a"].finish_reason == "cancelled"
+  assert fins["a"].new_tokens == 1
+  assert not sched.has_work
+
+
+def test_latency_class_jumps_fcfs_order():
+  clock = FakeClock()
+  sched = _sched(clock, num_slots=1)
+  admitted = []
+  sched.on_admit.append(admitted.append)
+  a, b, c = _prompts((3, 3, 3))
+  sched.submit(Request(uid="t1", prompt=a, max_new_tokens=1))
+  sched.plan_step()                            # t1 takes the only slot
+  sched.submit(Request(uid="t2", prompt=b, max_new_tokens=1))
+  sched.submit(Request(uid="lat", prompt=c, max_new_tokens=1,
+                       priority="latency"))
+  sched.commit(np.asarray([1], np.int32))      # t1 finishes (length)
+  sched.plan_step()                            # freed slot: lat jumps t2
+  sched.commit(np.asarray([1], np.int32))
+  sched.plan_step()
+  sched.commit(np.asarray([1], np.int32))
+  assert admitted == ["t1", "lat", "t2"]
+
+
+def test_on_finish_subscribers_compose():
+  """The hooks are subscriber LISTS — engine stats and resilience
+  callbacks must not clobber each other (ISSUE 6 satellite: the old
+  single-callback slot was silently overwritten)."""
+  clock = FakeClock()
+  sched = _sched(clock, num_slots=1)
+  got_a, got_b = [], []
+  sched.on_finish.append(lambda fin: got_a.append(fin.uid))
+  sched.on_finish.append(lambda fin: got_b.append(fin.uid))
+  (p,) = _prompts((3,))
+  sched.submit(Request(uid="x", prompt=p, max_new_tokens=1))
+  sched.plan_step()
+  sched.commit(np.asarray([1], np.int32))
+  assert got_a == ["x"] and got_b == ["x"]
+
+
+def test_requeue_slot_carries_committed_prefix():
+  clock = FakeClock()
+  sched = _sched(clock, num_slots=1)
+  (p,) = _prompts((3,))
+  sched.submit(Request(uid="r", prompt=p, max_new_tokens=8))
+  sched.plan_step()
+  sched.commit(np.asarray([9], np.int32))      # prefill done + 1 token
+  assert sched.requeue_slot(0) == "r"
+  assert sched.queue_depth == 1 and not sched.active
+  entry = sched.pending[0]
+  assert entry.prefix_len == len(p) + 1
+  plan = sched.plan_step()                     # readmitted: replay
+  assert plan.reset[0] and plan.prefilling[0]
+  np.testing.assert_array_equal(plan.tokens[0, :4], list(p) + [9])
+  # The replayed last-prefix sample IS the next stream token — it
+  # commits (same tok_index fold as the undisturbed decode step).
+  assert plan.tok_index[0] == 1
+  sched.commit(np.asarray([4], np.int32))
+  assert sched.active[0].generated == [9, 4]
+
+
+# ------------------------------------------------------- engine, no faults
+
+
+@pytest.mark.quick
+def test_fault_free_resilient_engine_bit_exact_zero_recompile():
+  """Quick acceptance: resilience enabled but no faults injected is a
+  pure no-op — token streams bit-identical to the baseline engine (and
+  the generate() oracle), with the fused step still compiled ONCE (the
+  finiteness verdict rides the same program)."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((5, 3, 9, 2))
+  max_new = (6, 7, 4, 5)
+
+  def drive(resilient):
+    eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                   prefill_chunk=4, resilience=resilient)
+    for i in range(2):
+      eng.submit(Request(uid=i, prompt=prompts[i],
+                         max_new_tokens=max_new[i]))
+    out = {}
+    for _ in range(2):
+      for fin in eng.step():
+        out[fin.uid] = fin.tokens
+    for i in range(2, 4):                      # staggered second wave
+      eng.submit(Request(uid=i, prompt=prompts[i],
+                         max_new_tokens=max_new[i]))
+    out.update(eng.run())
+    assert eng._step_fn._cache_size() == 1
+    return out
+
+  base, res = drive(False), drive(True)
+  assert sorted(base) == sorted(res) == list(range(4))
+  for i in range(4):
+    np.testing.assert_array_equal(res[i], base[i], err_msg=f"req {i}")
+    np.testing.assert_array_equal(
+        res[i], _oracle(model, params, prompts[i], max_new[i]))
+
+
+def test_engine_compile_once_after_ambient_mesh_built():
+  """Regression for the fit->engine recompile interplay (ROADMAP item 1
+  'First'; NOTES.md): once any component builds the cluster mesh (fit's
+  setup does), the fused step's activation constraints bind to it, so a
+  meshless engine's first-call input shardings used to disagree with
+  its output shardings — one recompile on call 2.  The engine now
+  adopts the ambient mesh at construction; the step must stay at ONE
+  compile in this construction order, and outputs stay exact."""
+  epl.init(epl.Config({"cluster.mesh_shape": "data:4,model:2"}))
+  epl.Env.get().cluster.build_mesh()           # what fit() does first
+  model, params = _model_and_params()
+  prompts = _prompts((5, 3), seed=4)
+  eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                 prefill_chunk=4)   # mesh NOT passed
+  for i, p in enumerate(prompts):
+    eng.submit(Request(uid=i, prompt=p, max_new_tokens=6))
+  out = eng.run()
+  assert eng._step_fn._cache_size() == 1, \
+      "fused step recompiled after build_mesh() — ambient-mesh adoption " \
+      "regressed (NOTES.md: fit->engine recompile interplay)"
+  for i, p in enumerate(prompts):
+    np.testing.assert_array_equal(out[i], _oracle(model, params, p, 6))
+
+
+# --------------------------------------------------------- chaos: NaN step
+
+
+def test_nan_step_retried_in_place_bit_exact():
+  """A transient NaN device step is retried exactly: the bad step never
+  advanced cursors, the replan re-feeds identical work, and the final
+  stream is bit-identical to the oracle — with the one compiled step
+  reused across the retry."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((5, 3))
+  eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                 prefill_chunk=4, resilience=True)
+  inj = chaos.NaNLogitsInjector(eng, bad_calls=(2,))
+  for i, p in enumerate(prompts):
+    eng.submit(Request(uid=i, prompt=p, max_new_tokens=6))
+  out = eng.run()
+  assert inj.poisoned == [2]
+  assert inj._cache_size() == 1
+  assert eng.stats.bad_steps == 1 and eng.stats.step_retries >= 1
+  for i, p in enumerate(prompts):
+    assert eng.finished[i].finish_reason == "length"
+    np.testing.assert_array_equal(out[i], _oracle(model, params, p, 6),
+                                  err_msg=f"req {i}")
+
+
+@pytest.mark.slow
+def test_persistent_nan_quarantines_and_replays_prefix_bit_exact():
+  """Two consecutive bad steps exceed max_step_retries=1: the slot is
+  quarantined — its request requeued with the committed prefix intact —
+  and the chunked-prefill replay reconstructs KV/cursor state exactly,
+  so the final output is STILL bit-identical to the oracle."""
+  epl.init()
+  model, params = _model_and_params()
+  (p,) = _prompts((5,))
+  eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                 prefill_chunk=4, resilience=True)
+  # Call 0-1: prefill (5 tokens over chunk 4).  Calls 2 and 3: the first
+  # decode step and its in-place retry, both poisoned -> quarantine.
+  inj = chaos.NaNLogitsInjector(eng, bad_calls=(2, 3))
+  eng.submit(Request(uid="q", prompt=p, max_new_tokens=6))
+  out = eng.run()
+  assert inj.poisoned == [2, 3]
+  assert inj._cache_size() == 1
+  assert eng.stats.requeues == 1
+  assert eng.finished["q"].finish_reason == "length"
+  np.testing.assert_array_equal(out["q"], _oracle(model, params, p, 6))
+
+
+@pytest.mark.slow
+def test_requeue_overflow_fails_request_not_batch():
+  """A request implicated past max_requeues is FAILED — it must not
+  poison the batch forever; a healthy request sharing the engine still
+  finishes bit-exactly."""
+  epl.init()
+  model, params = _model_and_params()
+  bad_p, good_p = _prompts((5, 3), seed=7)
+  eng = ContinuousBatchingEngine(
+      model, params, num_slots=1, prefill_chunk=4,
+      config=_res_config(max_step_retries=0, max_requeues=0))
+  inj = chaos.NaNLogitsInjector(eng, bad_calls=(1,))
+  eng.submit(Request(uid="bad", prompt=bad_p, max_new_tokens=6))
+  eng.submit(Request(uid="good", prompt=good_p, max_new_tokens=6))
+  out = eng.run()
+  # Call 1 finished "bad"'s prefill: its verdict was poisoned, and with
+  # zero retries/requeues budgeted the request fails with its committed
+  # prefix returned; the slot then serves "good" untouched.
+  assert eng.finished["bad"].finish_reason == "failed"
+  assert inj._cache_size() == 1
+  assert eng.finished["good"].finish_reason == "length"
+  np.testing.assert_array_equal(out["good"],
+                                _oracle(model, params, good_p, 6))
+
+
+# ------------------------------------------------- chaos: hangs & drafters
+
+
+@pytest.mark.slow
+def test_hung_step_trips_watchdog_outputs_exact():
+  """A stalled device call surfaces through the serving watchdog (log +
+  counter) without being interrupted — a hang is a latency fault, and
+  the stream stays bit-exact through it."""
+  epl.init()
+  model, params = _model_and_params()
+  (p,) = _prompts((4,))
+  eng = ContinuousBatchingEngine(
+      model, params, num_slots=1, prefill_chunk=4,
+      config=_res_config(step_timeout_s=0.05))
+  try:
+    inj = chaos.HangingStepInjector(eng, hang_calls=(1,), hang_s=0.4)
+    eng.submit(Request(uid="h", prompt=p, max_new_tokens=5))
+    out = eng.run()
+  finally:
+    eng.close()
+  assert inj.hangs == 1
+  assert eng.stats.watchdog_timeouts >= 1
+  np.testing.assert_array_equal(out["h"], _oracle(model, params, p, 5))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["raise", "garbage"])
+def test_flaky_drafter_never_costs_correctness(mode):
+  """A drafter that raises degrades to zero drafts for the step; one
+  that proposes garbage has it rejected by verification — either way
+  greedy output stays bit-exact and the step stays compiled once."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((5, 3), seed=2)
+  eng = ContinuousBatchingEngine(
+      model, params, num_slots=2, prefill_chunk=4, resilience=True,
+      drafter=chaos.FlakyDrafter(NgramDrafter(k=2), bad_calls=(1, 3),
+                                 mode=mode))
+  for i, p in enumerate(prompts):
+    eng.submit(Request(uid=i, prompt=p, max_new_tokens=8))
+  out = eng.run()
+  assert eng.drafter.faults >= 1
+  assert eng._step_fn._cache_size() == 1
+  if mode == "raise":
+    assert eng._drafter_failures >= 1
+  for i, p in enumerate(prompts):
+    np.testing.assert_array_equal(out[i], _oracle(model, params, p, 8),
+                                  err_msg=f"req {i} ({mode})")
+
+
+# ----------------------------------------------------- overload & shedding
+
+
+@pytest.mark.slow
+def test_bounded_queue_sheds_at_submit():
+  """Submits beyond queue_limit are rejected NOW (reason "shed", submit
+  returns False) instead of waiting hopelessly; every accepted request
+  still finishes bit-exactly."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((3, 4, 3, 5), seed=5)
+  eng = ContinuousBatchingEngine(
+      model, params, num_slots=1, prefill_chunk=4, max_batch=1,
+      config=_res_config(queue_limit=2))
+  accepted = [eng.submit(Request(uid=i, prompt=p, max_new_tokens=4))
+              for i, p in enumerate(prompts)]
+  assert accepted == [True, True, False, False]
+  assert eng.finished[2].finish_reason == "shed"
+  assert eng.finished[3].finish_reason == "shed"
+  assert eng.stats.shed_requests == 2
+  out = eng.run()
+  assert sorted(out) == [0, 1]
+  for i in (0, 1):
+    np.testing.assert_array_equal(
+        out[i], _oracle(model, params, prompts[i], 4), err_msg=f"req {i}")
+
+
+@pytest.mark.slow
+def test_stale_shed_level_clears_on_idle_submit():
+  """Regression: the ladder de-escalates inside step(), but an idle
+  engine never steps — if the queue drained without stepping (every
+  request cancelled after a shed-level observation), a stale shed level
+  must not reject 100% of traffic forever.  submit() re-observes the
+  (idle) load signals first."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((3, 4, 3, 5), seed=7)
+  eng = ContinuousBatchingEngine(
+      model, params, num_slots=1, prefill_chunk=4, max_batch=1,
+      config=_res_config(queue_limit=2, degrade_queue_frac=0.25))
+  eng.submit(Request(uid=0, prompt=prompts[0], max_new_tokens=4))
+  eng.step()                      # request 0 occupies the single slot
+  eng.submit(Request(uid=1, prompt=prompts[1], max_new_tokens=4))
+  eng.submit(Request(uid=3, prompt=prompts[3], max_new_tokens=4))
+  eng.step()                      # no free slot: backlog 2/2 -> shed
+  assert eng._admission.level == 3
+  assert eng.cancel(1) and eng.cancel(3) and eng.cancel(0)
+  assert not eng.has_work         # drained without another step
+  assert eng.submit(Request(uid="fresh", prompt=prompts[2],
+                            max_new_tokens=4)), \
+      "idle engine with a stale shed level must accept new work"
+  out = eng.run()
+  np.testing.assert_array_equal(
+      out["fresh"], _oracle(model, params, prompts[2], 4))
+  assert eng._step_fn._cache_size() == 1
+
+
+@pytest.mark.slow
+def test_poisson_overload_episode_chaos_acceptance():
+  """The `make chaos-serve` overload headline: a Poisson arrival burst
+  against a bounded queue walks the degradation ladder (speculation off
+  -> budget tightened -> shed) and back down; every NON-shed request
+  finishes bit-exact vs generate(use_cache=True), every shed one
+  carries reason "shed", and the fused step compiles exactly once
+  across all transitions."""
+  epl.init()
+  model, params = _model_and_params()
+  n = 12
+  prompts = _prompts(tuple(3 + (i % 4) for i in range(n)), seed=6)
+  arrivals = chaos.poisson_trace(rate_per_s=400.0, n=n, seed=1)
+  eng = ContinuousBatchingEngine(
+      model, params, num_slots=2, prefill_chunk=4,
+      drafter=NgramDrafter(k=2),
+      config=_res_config(queue_limit=4, degrade_queue_frac=0.25))
+  # Drive arrivals against engine steps: each step advances "time" by
+  # one mean service tick, submitting whatever arrived since.
+  t, tick, nxt = 0.0, 1.0 / 400.0, 0
+  while nxt < n or eng.has_work:
+    t += tick
+    while nxt < n and arrivals[nxt] <= t:
+      eng.submit(Request(uid=nxt, prompt=prompts[nxt],
+                         max_new_tokens=4))
+      nxt += 1
+    eng.step()
+  assert eng._step_fn._cache_size() == 1
+  shed = {u for u, f in eng.finished.items() if f.finish_reason == "shed"}
+  assert shed, "overload episode never shed — not an overload"
+  assert eng._admission.transitions >= 2     # up AND back down
+  assert len(eng.finished) == n
+  for i in range(n):
+    if i in shed:
+      assert eng.finished[i].new_tokens == 0
+    else:
+      assert eng.finished[i].finish_reason == "length"
+      np.testing.assert_array_equal(
+          eng.finished[i].tokens, _oracle(model, params, prompts[i], 4),
+          err_msg=f"req {i}")
