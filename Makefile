@@ -25,6 +25,13 @@ chaos-serve:
 serve-bench:
 	python benchmarks/decode_throughput.py
 
+# Paged vs contiguous KV on a long-tail (64-4k mixed prompt) trace:
+# useful tokens/s, steady-state decode step cost, concurrency at fixed
+# HBM (benchmarks/decode_throughput.py --paged -> BENCH_EVIDENCE.json;
+# docs/serving.md "Paged KV cache").
+paged-bench:
+	python benchmarks/decode_throughput.py --paged
+
 # Speculative vs plain decode on repetitive/incompressible traces
 # (benchmarks/speculative_decode.py -> BENCH_EVIDENCE.json; docs/serving.md).
 spec-bench:
@@ -44,4 +51,4 @@ trace-demo:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test bench chaos chaos-serve serve-bench spec-bench overload-bench trace-demo clean
+.PHONY: all build test bench chaos chaos-serve serve-bench paged-bench spec-bench overload-bench trace-demo clean

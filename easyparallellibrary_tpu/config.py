@@ -417,6 +417,31 @@ class ServingConfig(_Category):
       # memory linearly with requests served).  run()'s return value is
       # unaffected — it collects each call's retirements directly.
       "finished_limit": 0,
+      # --- paged KV cache + token-flat fused step (serving/kv_cache.py,
+      # docs/serving.md "Paged KV cache").  Off by default: the
+      # contiguous slot layout stays byte-identical.  On, per-slot K/V
+      # lives in fixed-size blocks behind a block table, the fused step
+      # becomes a [token_budget] flat batch (decode cost scales with
+      # scheduled tokens, not num_slots * chunk), and block-pool
+      # exhaustion preempts the youngest lowest-priority slot instead of
+      # capping admission at worst-case length.
+      "paged.enabled": False,
+      # Tokens per KV block.  Must divide max_seq_len (the paged
+      # attend's reduction length must equal the oracle's cache length
+      # for greedy bit-exactness — kv_cache.blocks_per_slot).
+      "paged.block_size": 16,
+      # Pool size in blocks (one is reserved as the null block).  0 =
+      # auto: num_slots * max_seq_len / block_size + 1 — byte parity
+      # with the contiguous layout.  Size it SMALLER (or raise
+      # num_slots) to turn unused worst-case tail into extra concurrent
+      # requests; must always hold at least one full-length request.
+      "paged.num_blocks": 0,
+      # Flat positions per fused step (the step's whole compute bill).
+      # 0 = auto: num_slots + 2 * prefill_chunk.  Must at least cover
+      # every decoding slot's one guaranteed token (>= the effective
+      # batch cap); prefill chunks and speculative drafts share the
+      # rest.
+      "paged.token_budget": 0,
       # --- speculative decoding (serving/speculative/, docs/serving.md).
       # Draft k tokens per decode slot and verify them in the SAME fused
       # step (the drafts ride chunk positions plain decode wastes), so
@@ -468,6 +493,10 @@ class ServingConfig(_Category):
   @property
   def speculative(self) -> _SubGroup:
     return _SubGroup(self, "speculative")
+
+  @property
+  def paged(self) -> _SubGroup:
+    return _SubGroup(self, "paged")
 
   @property
   def resilience(self) -> _SubGroup:
@@ -654,6 +683,16 @@ class Config:
     if self.serving.finished_limit < 0:
       raise ValueError(f"serving.finished_limit must be >= 0 (0 = keep "
                        f"all); got {self.serving.finished_limit}")
+    paged = self.serving.paged
+    if paged.block_size < 1:
+      raise ValueError(f"serving.paged.block_size must be >= 1; "
+                       f"got {paged.block_size}")
+    if paged.num_blocks < 0:
+      raise ValueError(f"serving.paged.num_blocks must be >= 0 (0 = "
+                       f"auto); got {paged.num_blocks}")
+    if paged.token_budget < 0:
+      raise ValueError(f"serving.paged.token_budget must be >= 0 (0 = "
+                       f"auto); got {paged.token_budget}")
     spec = self.serving.speculative
     if spec.k < 1:
       raise ValueError(

@@ -1,3 +1,11 @@
 from easyparallellibrary_tpu.kernels.flash_attention import flash_attention
+from easyparallellibrary_tpu.kernels.paged_attention import (
+    paged_attention, paged_attention_pallas, paged_attention_reference,
+    set_paged_attention_impl,
+)
 
-__all__ = ["flash_attention"]
+__all__ = [
+    "flash_attention",
+    "paged_attention", "paged_attention_pallas",
+    "paged_attention_reference", "set_paged_attention_impl",
+]

@@ -164,6 +164,99 @@ def slot_cache_attend(q, k, v, cached_k, cached_v, cursors, dtype):
   return out, cached_k, cached_v
 
 
+@dataclasses.dataclass
+class PagedInfo:
+  """Per-step paged-decode routing, threaded through the model to every
+  attention layer (the paged twin of the ``slot_cursors`` vector).
+  Built once per fused step by :func:`paged_step_logits`; deliberately a
+  PLAIN dataclass (not a pytree) so the static ``impl`` string rides
+  along without entering any jit signature.
+
+  ``write_idx`` int32 ``[T]`` — flat pool row (block * block_size +
+  offset) each token's K/V scatter-writes to; padding tokens and
+  positions past the virtual length are pre-routed to the null block
+  (serving/kv_cache.NULL_BLOCK).  ``tables_tok`` int32 ``[T, MB]`` —
+  each token's slot block-table row.  ``positions`` int32 ``[T]`` —
+  absolute positions (the causal bound).  ``impl`` — resolved
+  paged-attention implementation (kernels/paged_attention.py dispatch).
+  """
+  write_idx: Any
+  tables_tok: Any
+  positions: Any
+  impl: str = "reference"
+
+
+def paged_cache_attend(q, k, v, k_pages, v_pages, paged_info, dtype):
+  """Paged-pool KV attention — the block-table twin of
+  :func:`slot_cache_attend`, sharing its contracts: write this step's
+  K/V first, then attend with the per-token causal bound masking
+  everything newer or stale; garbage rows are masked-but-contracted, so
+  the FINITENESS INVARIANT (slot_cache_attend docstring) applies to
+  pool rows verbatim — including the null block, which absorbs padding
+  writes (the resilient engine's sanitize pass zeroes it with any
+  poisoned slot).
+
+  ``q``/``k``/``v`` are ``[T, H, hd]`` flat-token projections;
+  ``k_pages``/``v_pages`` ``[NB, bs, H, hd]`` pools.  The attend itself
+  dispatches through ``kernels.paged_attention`` (Pallas on TPU, the
+  bit-exact jnp reference elsewhere).
+
+  Returns ``(out [T, H, hd], new_k_pages, new_v_pages)``.
+  """
+  from easyparallellibrary_tpu.kernels.paged_attention import (
+      paged_attention)
+  NB, bs, H, hd = k_pages.shape
+  flat = (NB * bs, H, hd)
+  k_pages = k_pages.reshape(flat).at[paged_info.write_idx].set(
+      k.astype(k_pages.dtype)).reshape(NB, bs, H, hd)
+  v_pages = v_pages.reshape(flat).at[paged_info.write_idx].set(
+      v.astype(v_pages.dtype)).reshape(NB, bs, H, hd)
+  out = paged_attention(q, k_pages, v_pages, paged_info.tables_tok,
+                        paged_info.positions, impl=paged_info.impl)
+  return out.astype(dtype), k_pages, v_pages
+
+
+def paged_step_logits(model, params, kv, tokens, slot_ids, positions,
+                      valid, block_tables, impl: str = "reference"):
+  """Flat-token scoring against the paged KV cache — the paged twin of
+  :func:`slot_step_logits` and THE device entry of the token-flat
+  serving step (serving/engine.py).
+
+  One call scores ``tokens`` (int32 ``[T]``, each tagged with its slot
+  and absolute position) against the paged pools: token ``t`` writes
+  K/V at its slot's block-table row for ``positions[t]`` and attends its
+  own causal prefix through the table.  Prefill chunks, one-token
+  decodes, and speculative drafts of DIFFERENT slots ride one flat
+  batch; compute is proportional to ``T`` (the scheduled-token budget),
+  not ``num_slots * chunk``.  Invalid (padding) tokens write to the
+  null block and their logits are garbage the scheduler never consumes.
+
+  Returns ``(logits [T, vocab], new_kv)``.
+  """
+  T = tokens.shape[0]
+  MB = block_tables.shape[1]
+  bs = None
+  for leaf in jax.tree_util.tree_leaves(kv):
+    bs = leaf.shape[1]
+    break
+  L = MB * bs
+  tables_tok = jnp.take(block_tables, slot_ids, axis=0)      # [T, MB]
+  blk = jnp.take_along_axis(
+      tables_tok, jnp.clip(positions // bs, 0, MB - 1)[:, None],
+      axis=1)[:, 0]
+  real_idx = blk * bs + positions % bs
+  # Padding tokens — and any position past the virtual length (a draft
+  # rollout's overshoot) — write to the null block's rows instead.
+  trash_idx = jnp.arange(T, dtype=jnp.int32) % bs
+  write_idx = jnp.where(valid & (positions < L), real_idx, trash_idx)
+  info = PagedInfo(write_idx=write_idx, tables_tok=tables_tok,
+                   positions=positions, impl=impl)
+  logits, mut = model.apply(
+      {"params": params, "cache": kv}, tokens[:, None], decode=True,
+      paged_info=info, mutable=["cache"])
+  return logits[:, 0], mut["cache"]
+
+
 def slot_step_logits(model, params, kv, tokens, cursors):
   """Multi-token scoring on the shared slot-cache core — THE device entry
   every serving component steps through.
@@ -218,7 +311,7 @@ class CausalSelfAttention(nn.Module):
   decode: bool = False
 
   @nn.compact
-  def __call__(self, x, slot_cursors=None):
+  def __call__(self, x, slot_cursors=None, paged_info=None):
     cfg = self.cfg
     B, S, D = x.shape
     H = cfg.num_heads
@@ -235,7 +328,17 @@ class CausalSelfAttention(nn.Module):
                             constants.MODEL_AXIS, None))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
-    if self.decode:
+    if paged_info is not None:
+      # Flat-token paged decode (serving/engine.py paged mode): x is
+      # [T, 1, D] — one token per batch row — and attention routes
+      # through the slot block tables instead of a contiguous cache.
+      ck = self.variable("cache", "cached_key", _missing_slot_cache)
+      cv = self.variable("cache", "cached_value", _missing_slot_cache)
+      out, ck.value, cv.value = paged_cache_attend(
+          q[:, 0], k[:, 0], v[:, 0], ck.value, cv.value, paged_info,
+          cfg.dtype)
+      out = out[:, None]
+    elif self.decode:
       out = self._decode_attend(q, k, v, slot_cursors)
     elif cfg.attn_impl == "ring":
       from easyparallellibrary_tpu.sequence.ring_attention import (
@@ -338,14 +441,15 @@ class Block(nn.Module):
   decode: bool = False
 
   @nn.compact
-  def __call__(self, x, slot_cursors=None):
+  def __call__(self, x, slot_cursors=None, paged_info=None):
     cfg = self.cfg
     drop = nn.Dropout(rate=cfg.dropout_rate,
                       deterministic=self.deterministic
                       or cfg.dropout_rate == 0.0)
     y = LayerNorm(dtype=cfg.dtype, name="ln1")(x)
     x = x + drop(CausalSelfAttention(cfg, decode=self.decode,
-                                     name="attn")(y, slot_cursors))
+                                     name="attn")(y, slot_cursors,
+                                                  paged_info))
     y = LayerNorm(dtype=cfg.dtype, name="ln2")(x)
     if self.use_moe:
       from easyparallellibrary_tpu.models.moe import MoEMLP
@@ -495,21 +599,31 @@ class GPT(nn.Module):
   @nn.compact
   def __call__(self, ids, deterministic: bool = True,
                decode: bool = False, return_hidden: bool = False,
-               slot_cursors=None):
+               slot_cursors=None, paged_info=None):
     from easyparallellibrary_tpu.runtime.amp import resolve_model_dtypes
     cfg = resolve_model_dtypes(self.cfg)
     B, S = ids.shape
     if decode and cfg.pipeline_stages > 1:
       raise ValueError("KV-cache decode is single-program; run generation "
                        "on a non-pipelined config (pipeline_stages=1)")
-    if slot_cursors is not None and not decode:
-      raise ValueError("slot_cursors is a decode-mode argument "
+    if (slot_cursors is not None or paged_info is not None) and not decode:
+      raise ValueError("slot_cursors/paged_info are decode-mode arguments "
                        "(serving engine); pass decode=True")
     tok = _tied_embedding(cfg, name="wte")
     pos_init = nn.initializers.normal(stddev=0.02)
     pos = self.param("wpe", nn.with_partitioning(pos_init, (None, None)), (cfg.max_seq_len, cfg.d_model),
                      cfg.param_dtype)
-    if slot_cursors is not None:
+    if paged_info is not None:
+      # Paged flat-token mode (serving paged engine): ids is [T, 1] —
+      # one token per batch row — and absolute positions come from the
+      # step plan's per-token position vector.  Out-of-range positions
+      # (padding rows, draft-rollout overshoot) clip; their outputs are
+      # never consumed.
+      pos_ids = jnp.clip(paged_info.positions, 0,
+                         cfg.max_seq_len - 1)[:, None]        # [T, 1]
+      pos_slice = jnp.take(jnp.asarray(pos), pos_ids, axis=0)  # [T, 1, D]
+      x = tok(ids).astype(cfg.dtype) + pos_slice.astype(cfg.dtype)
+    elif slot_cursors is not None:
       # Slot mode (serving): absolute positions come straight from the
       # per-slot cursor vector — no pos_index variable; the engine owns
       # cursor advancement.  Past-capacity positions of garbage token
@@ -583,7 +697,8 @@ class GPT(nn.Module):
         use_moe = cfg.num_experts > 0 and \
           (i % cfg.moe_every == cfg.moe_every - 1)
         x = block_cls(cfg, use_moe=use_moe, deterministic=deterministic,
-                      decode=decode, name=f"block_{i}")(x, slot_cursors)
+                      decode=decode, name=f"block_{i}")(x, slot_cursors,
+                                                        paged_info)
 
     x = LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
     if return_hidden:

@@ -124,6 +124,11 @@ def request_timelines(events: List[Dict[str, Any]]
                   if s["name"] == "speculate")
     accepted = sum(s["args"].get("accepted", 0) for s in inner
                    if s["name"] == "speculate")
+    # Paged engine: each per-step span carries the slot's block count
+    # (engine._trace_slot_spans); the request's peak is its KV
+    # footprint high-water mark in blocks.  0 on a contiguous engine.
+    kv_blocks_peak = max(
+        (s["args"].get("kv_blocks", 0) for s in inner), default=0)
     submit = submits.get(uid)
     ttft = first_tokens.get(uid)
     requests.append({
@@ -139,6 +144,7 @@ def request_timelines(events: List[Dict[str, Any]]
                             if s["name"] in ("decode", "speculate")),
         "decode_us": phase_us["decode"] + phase_us["speculate"],
         "drafted": drafted, "accepted": accepted,
+        "kv_blocks_peak": kv_blocks_peak,
         "new_tokens": req["args"].get("new_tokens"),
         "finish_reason": req["args"].get("finish_reason"),
         "requeues": requeues.get(uid, 0),
@@ -159,7 +165,7 @@ def request_timelines(events: List[Dict[str, Any]]
         "total_us": None, "ttft_us": None,
         "prefill_us": 0.0, "prefill_chunks": 0,
         "decode_steps": 0, "decode_us": 0.0,
-        "drafted": 0, "accepted": 0,
+        "drafted": 0, "accepted": 0, "kv_blocks_peak": 0,
         "new_tokens": None, "finish_reason": reason,
         "requeues": requeues.get(uid, 0),
     })
@@ -197,16 +203,23 @@ def format_report(events: List[Dict[str, Any]]) -> str:
   requests = request_timelines(events)
   if requests:
     lines.append("")
+    # The blk column (peak KV blocks held) only appears when any request
+    # actually ran paged — a contiguous-engine trace keeps its old shape.
+    paged = any(r["kv_blocks_peak"] for r in requests)
     lines.append(f"{'request':<12}{'wait':>9}{'ttft':>10}{'prefill':>10}"
                  f"{'chunks':>7}{'decode':>10}{'steps':>6}{'drafted':>8}"
-                 f"{'accepted':>9}{'rq':>4}{'total':>10}  finish")
+                 f"{'accepted':>9}{'rq':>4}"
+                 + (f"{'blk':>5}" if paged else "")
+                 + f"{'total':>10}  finish")
     for r in requests:
       lines.append(
           f"{r['uid']:<12}{_fmt_us(r['queue_wait_us']):>9}"
           f"{_fmt_us(r['ttft_us']):>10}{_fmt_us(r['prefill_us']):>10}"
           f"{r['prefill_chunks']:>7}{_fmt_us(r['decode_us']):>10}"
           f"{r['decode_steps']:>6}{r['drafted']:>8}{r['accepted']:>9}"
-          f"{r['requeues']:>4}{_fmt_us(r['total_us']):>10}"
+          f"{r['requeues']:>4}"
+          + (f"{r['kv_blocks_peak']:>5}" if paged else "")
+          + f"{_fmt_us(r['total_us']):>10}"
           f"  {r['finish_reason'] or '-'}")
   counters = sorted({e["name"] for e in events if e.get("ph") == "C"})
   if counters:

@@ -114,6 +114,13 @@ class ServingStats:
     self.degraded_level = 0
     self.watchdog_timeouts = 0
     self.finish_reasons: Dict[str, int] = {}
+    # Paged KV block-pool gauges (last-seen; all 0 on a contiguous
+    # engine): free/used blocks, internal fragmentation, and cumulative
+    # preemptions (docs/serving.md "Paged KV cache").
+    self.kv_blocks_free = 0
+    self.kv_blocks_used = 0
+    self.kv_fragmentation = 0.0
+    self.preemptions = 0
     # Live ITL estimate: EWMA of decode-step wall time (module
     # docstring).  0.0 until the SECOND decoding step — the first
     # decode-step sample can carry one-time XLA compile work (a draft
@@ -175,6 +182,16 @@ class ServingStats:
     self.bad_steps = int(counters["bad_steps"])
     self.step_retries = int(counters["step_retries"])
     self.requeues = int(counters["requeues"])
+
+  def note_blocks(self, free: int, used: int, fragmentation: float,
+                  preemptions: int):
+    """Paged block-pool gauges, fed per step by the paged engine
+    (last-write-wins: these are levels, not counters — except
+    ``preemptions``, which the scheduler accumulates)."""
+    self.kv_blocks_free = int(free)
+    self.kv_blocks_used = int(used)
+    self.kv_fragmentation = float(fragmentation)
+    self.preemptions = int(preemptions)
 
   def note_degraded(self, level: int):
     self.degraded_transitions += 1
@@ -266,6 +283,12 @@ class ServingStats:
         "accepted_per_step_mean": (sum(acc) / len(acc)) if acc else 0.0,
         "accepted_per_step_p50": percentile(acc, 50),
         "accepted_per_step_p99": percentile(acc, 99),
+        # Paged block pool (all 0.0 on a contiguous engine; docs/
+        # serving.md "Paged KV cache").
+        "kv_blocks_free": float(self.kv_blocks_free),
+        "kv_blocks_used": float(self.kv_blocks_used),
+        "kv_fragmentation": float(self.kv_fragmentation),
+        "preemptions": float(self.preemptions),
         # Resilience (all 0.0 on a non-resilient engine; docs/
         # robustness.md "Serving resilience").
         "shed": float(self.shed_requests),

@@ -22,11 +22,12 @@ from easyparallellibrary_tpu.serving.resilience import (
     DEGRADE_LEVELS, AdmissionController, BadStepPolicy,
 )
 from easyparallellibrary_tpu.serving.kv_cache import (
-    SlotAllocator, allocate_kv_cache, cache_bytes, cache_length,
-    kv_cache_shardings,
+    NULL_BLOCK, BlockAllocator, SlotAllocator, allocate_kv_cache,
+    allocate_paged_kv_cache, blocks_per_slot, cache_bytes, cache_length,
+    default_num_blocks, kv_cache_shardings, paged_cache_bytes,
 )
 from easyparallellibrary_tpu.serving.scheduler import (
-    FCFSScheduler, FinishedRequest, Request, StepPlan,
+    FCFSScheduler, FinishedRequest, PagedStepPlan, Request, StepPlan,
 )
 from easyparallellibrary_tpu.serving.speculative import (
     Drafter, DraftModelDrafter, NgramDrafter, ngram_propose,
@@ -37,7 +38,10 @@ __all__ = [
     "ContinuousBatchingEngine", "filtered_logits", "sample_token_slots",
     "SlotAllocator", "allocate_kv_cache", "cache_bytes", "cache_length",
     "kv_cache_shardings",
-    "FCFSScheduler", "FinishedRequest", "Request", "StepPlan",
+    "NULL_BLOCK", "BlockAllocator", "allocate_paged_kv_cache",
+    "blocks_per_slot", "default_num_blocks", "paged_cache_bytes",
+    "FCFSScheduler", "FinishedRequest", "PagedStepPlan", "Request",
+    "StepPlan",
     "check_draft_compatible", "check_servable",
     "AdmissionController", "BadStepPolicy", "DEGRADE_LEVELS",
     "FINISH_REASONS", "PRIORITIES",

@@ -23,9 +23,11 @@ ROADMAP_DRAFT_DISTILL = (
     "training a matched drafter is a ROADMAP follow-up ('draft-model "
     "distillation'; docs/serving.md 'Speculative decoding')")
 ROADMAP_PREEMPTION = (
-    "preempting a RUNNING decode (paging its KV out for a latency-class "
-    "arrival) needs the paged cache — ROADMAP open item 1; today "
-    "priority only reorders ADMISSION")
+    "priority reorders ADMISSION, and on the paged engine "
+    "(serving.paged.enabled) block-pool exhaustion preempts the "
+    "youngest lowest-priority RUNNING slot (reason 'preempted'); "
+    "proactive latency-class preemption before the pool runs dry is a "
+    "ROADMAP follow-up (item 2)")
 
 # Finish-reason glossary (docs/robustness.md "Serving resilience"):
 #   length      — max_new_tokens reached
@@ -35,8 +37,13 @@ ROADMAP_PREEMPTION = (
 #   shed        — rejected at submit by admission control (overload)
 #   failed      — quarantined more than serving.resilience.max_requeues
 #                 times (persistent bad steps implicating this request)
+#   preempted   — paged out mid-flight because the KV block pool ran dry
+#                 (paged engine; rides the requeue prefix-replay path, so
+#                 unlike the others it names a REQUEUE, not a final
+#                 resolution — the request finishes later under one of
+#                 the reasons above with its output bit-intact)
 FINISH_REASONS = ("length", "stop_token", "deadline", "cancelled",
-                  "shed", "failed")
+                  "shed", "failed", "preempted")
 
 # Admission classes: "latency" jumps the FCFS queue, "throughput" rides
 # it.  (True preemption of running requests: ROADMAP_PREEMPTION.)

@@ -191,6 +191,10 @@ class ContinuousBatchingEngine:
                drafter=None, speculative: Optional[bool] = None,
                draft_model=None, draft_params=None,
                resilience: Optional[bool] = None,
+               paged: Optional[bool] = None,
+               block_size: Optional[int] = None,
+               num_blocks: Optional[int] = None,
+               token_budget: Optional[int] = None,
                stats=None, metrics_writer=None, registry=None,
                config=None):
     cfg = model.cfg
@@ -215,15 +219,48 @@ class ContinuousBatchingEngine:
       raise ValueError(
           f"prefill_token_budget {budget} below prefill_chunk "
           f"{self.chunk}: no admission could ever afford its first chunk")
+    # Paged mode (serving.paged.*; docs/serving.md "Paged KV cache"):
+    # token-flat fused step over a block-table cache — decode cost
+    # scales with scheduled tokens, concurrency with blocks, not with
+    # num_slots * max_seq_len.
+    pconf = conf.paged
+    self.paged = paged if paged is not None else pconf.enabled
+    eff_batch = max_batch if max_batch is not None else conf.max_batch
+    if self.paged:
+      self.block_size = (block_size if block_size is not None
+                         else pconf.block_size)
+      mb = kv_lib.blocks_per_slot(cfg, self.block_size)
+      self.num_blocks = (num_blocks if num_blocks is not None
+                         else pconf.num_blocks)
+      if self.num_blocks <= 0:
+        self.num_blocks = kv_lib.default_num_blocks(cfg, self.num_slots,
+                                                    self.block_size)
+      self.token_budget = (token_budget if token_budget is not None
+                           else pconf.token_budget)
+      if self.token_budget <= 0:
+        # Auto: every decode slot's guaranteed token plus two prefill
+        # chunks of admission headroom per step.
+        self.token_budget = self.num_slots + 2 * self.chunk
+      # Resolve the attend implementation ONCE (kernels/paged_attention
+      # dispatch rule: Pallas on TPU, the bit-exact jnp reference
+      # elsewhere) so the jitted step never consults the environment.
+      from easyparallellibrary_tpu.kernels.paged_attention import (
+          default_paged_impl)
+      self._paged_impl = default_paged_impl()
+    else:
+      self.block_size = self.num_blocks = self.token_budget = 0
+      self._paged_impl = None
     self.drafter = self._resolve_drafter(conf, drafter, speculative,
                                          draft_model, draft_params)
     self.scheduler = FCFSScheduler(
         num_slots=self.num_slots, prefill_chunk=self.chunk,
         max_seq_len=cfg.max_seq_len, prefill_token_budget=budget,
-        max_batch=max_batch if max_batch is not None else conf.max_batch,
+        max_batch=eff_batch,
         stop_token=stop_token if stop_token is not None
         else conf.stop_token,
-        spec_k=self.drafter.k if self.drafter is not None else 0)
+        spec_k=self.drafter.k if self.drafter is not None else 0,
+        block_size=self.block_size, num_blocks=self.num_blocks,
+        token_budget=self.token_budget)
     res_conf = conf.resilience
     self._resilient = (resilience if resilience is not None
                        else res_conf.enabled)
@@ -286,8 +323,13 @@ class ContinuousBatchingEngine:
             self, self._watchdog.close)
     self._drafter_failures = 0
     self._drafter_fail_logged = False
-    self._kv, self._cursors = kv_lib.allocate_kv_cache(
-        cfg, self.num_slots, self.chunk, self.mesh)
+    if self.paged:
+      self._kv = kv_lib.allocate_paged_kv_cache(
+          cfg, self.num_blocks, self.block_size, self.mesh)
+      self._cursors = None
+    else:
+      self._kv, self._cursors = kv_lib.allocate_kv_cache(
+          cfg, self.num_slots, self.chunk, self.mesh)
     # Quarantine hygiene: a poisoned device step leaves non-finite K/V
     # in a bad slot's cache, and slot_cache_attend's V contraction
     # touches every cache row (0 * NaN = NaN), so the poison must be
@@ -297,7 +339,12 @@ class ContinuousBatchingEngine:
     # guaranteed to rewrite its OWN grant window, which can be smaller
     # than the bad step's (speculation degraded off, drafter fault,
     # prefill budget tightened between steps).  Separate tiny program;
-    # dispatched only on bad-step events, compiles once.
+    # dispatched only on bad-step events, compiles once.  The SAME
+    # program serves both layouts: dim 0 is slots (contiguous) or pool
+    # blocks (paged), dim 1 rows within — the paged host side maps slot
+    # block lists to (block mask, per-block start row) and always
+    # includes the null block, which a NaN-params step poisons through
+    # padding writes.
     self._sanitize_fn = jax.jit(
         lambda kv, mask, start: jax.tree_util.tree_map(
             lambda x: jnp.where(
@@ -314,14 +361,25 @@ class ContinuousBatchingEngine:
     donate = conf.donate_cache if donate_cache is None else donate_cache
     if self.drafter is not None:
       self.drafter.bind(self)
-      self._step_fn = self._build_spec_step(donate, self._resilient)
+      self._step_fn = (self._build_paged_spec_step(donate, self._resilient)
+                       if self.paged
+                       else self._build_spec_step(donate, self._resilient))
+    elif self.paged:
+      self._step_fn = self._build_paged_step(donate, self._resilient)
     else:
       self._step_fn = self._build_step(donate, self._resilient)
+    if self.paged:
+      layout = (f"paged: {self.num_blocks} x {self.block_size}-token "
+                f"blocks, token budget {self.token_budget}, "
+                f"{self._paged_impl} attend, "
+                f"{kv_lib.paged_cache_bytes(cfg, self.num_blocks, self.block_size) / 1e6:.1f} MB")
+    else:
+      layout = (f"contiguous slots, "
+                f"{kv_lib.cache_bytes(cfg, self.num_slots, self.chunk) / 1e6:.1f} MB")
     get_logger().info(
-        "serving engine: %d slots x chunk %d (cache %.1f MB, %s), "
+        "serving engine: %d slots x chunk %d (%s, %s), "
         "prefill budget %s, max batch %d, speculation %s, resilience %s",
-        self.num_slots, self.chunk,
-        kv_lib.cache_bytes(cfg, self.num_slots, self.chunk) / 1e6,
+        self.num_slots, self.chunk, layout,
         "mesh-sharded" if self.mesh is not None else "single-program",
         budget or "uncapped", self.scheduler.max_batch,
         f"{type(self.drafter).__name__}(k={self.drafter.k})"
@@ -374,21 +432,25 @@ class ContinuousBatchingEngine:
 
   # ----------------------------------------------------------- device step
 
-  def _jit_step(self, step, donate: bool, n_rep_in: int, n_rep_out: int):
+  def _jit_step(self, step, donate: bool, n_rep_in: int, n_rep_out: int,
+                cursors: bool = True):
     """jit a fused step with the engine's donation/placement discipline:
-    cache + cursors donated (argnums 1, 2), everything after them
-    replicated when a mesh is attached."""
+    cache (+ cursors in the contiguous layout) donated, everything after
+    them replicated when a mesh is attached.  The paged step has no
+    device cursors — positions are host-planned per step — so only the
+    cache pools donate (``cursors=False``)."""
     jit_kwargs: Dict[str, Any] = {}
     if donate:
-      jit_kwargs["donate_argnums"] = (1, 2)   # cache + cursors
+      jit_kwargs["donate_argnums"] = (1, 2) if cursors else (1,)
     if self.mesh is not None:
       from easyparallellibrary_tpu.parallel.api import state_shardings
       kv_sh, cur_sh = kv_lib.kv_cache_shardings(self.model.cfg, self.mesh)
       param_sh = state_shardings(self.params, self.mesh)
       rep = cur_sh
-      jit_kwargs["in_shardings"] = (
-          (param_sh, kv_sh, cur_sh) + (rep,) * n_rep_in)
-      jit_kwargs["out_shardings"] = (rep,) * n_rep_out + (kv_sh, cur_sh)
+      state_in = (param_sh, kv_sh) + ((cur_sh,) if cursors else ())
+      state_out = (kv_sh,) + ((cur_sh,) if cursors else ())
+      jit_kwargs["in_shardings"] = state_in + (rep,) * n_rep_in
+      jit_kwargs["out_shardings"] = (rep,) * n_rep_out + state_out
     return jax.jit(step, **jit_kwargs)
 
   def _build_step(self, donate: bool, guard: bool = False):
@@ -473,6 +535,88 @@ class ContinuousBatchingEngine:
 
     return self._jit_step(step, donate, n_rep_in=9,
                           n_rep_out=3 if guard else 2)
+
+  def _build_paged_step(self, donate: bool, guard: bool = False):
+    """Token-flat fused step over the paged cache: ONE model call scores
+    the whole ``[token_budget]`` flat batch (prefill chunks, one-token
+    decodes — each position tagged with slot and absolute position) so
+    device compute scales with scheduled tokens, not
+    ``num_slots * chunk``.  Shapes are static in ``token_budget`` /
+    ``num_slots`` / the block-table width; block tables, positions and
+    validity are data — joins, leaves and pool reshuffles never
+    recompile.  No device cursors: positions are host-planned, so the
+    only persistent device state is the donated pool pair."""
+    from easyparallellibrary_tpu.models.gpt import paged_step_logits
+    model = self.model
+    T = self.token_budget
+    impl = self._paged_impl
+
+    def step(params, kv, tokens, slot_ids, positions, valid, tables,
+             last_idx, active, keys, tok_index, temperature, top_k,
+             top_p):
+      logits, kv = paged_step_logits(model, params, kv, tokens, slot_ids,
+                                     positions, valid, tables, impl=impl)
+      # Each slot's next-token logits sit at its LAST scheduled flat
+      # position; idle slots read row 0 — garbage the scheduler never
+      # consumes (same contract as the slot step's num_valid=0 rows).
+      last = jnp.take(logits, jnp.clip(last_idx, 0, T - 1), axis=0)
+      step_keys = jax.vmap(jax.random.fold_in)(keys, tok_index)
+      nxt = sample_token_slots(last.astype(jnp.float32), step_keys,
+                               temperature, top_k, top_p)
+      if not guard:
+        return nxt, kv
+      slot_ok = jnp.all(jnp.isfinite(last), axis=-1) | ~active
+      return nxt, slot_ok, kv
+
+    return self._jit_step(step, donate, n_rep_in=12,
+                          n_rep_out=2 if guard else 1, cursors=False)
+
+  def _build_paged_spec_step(self, donate: bool, guard: bool = False):
+    """The speculative twin of :meth:`_build_paged_step`: drafts ride
+    LEFTOVER flat-budget positions (scheduler pass 3) instead of wasted
+    chunk columns, the same single model call scores them, and
+    verification gathers each slot's K+1 target rows by flat index
+    (row 0 at the slot's last real token, rows 1..K at its draft
+    positions).  No cursor rollback — the host plans next step's
+    positions from the committed count, so rejection is pure
+    bookkeeping, and rejected-draft K/V beyond it is masked garbage
+    overwritten on the next feed, exactly like chunked-prefill
+    garbage."""
+    from easyparallellibrary_tpu.models.gpt import paged_step_logits
+    from easyparallellibrary_tpu.serving.speculative.verify import (
+        verify_tokens)
+    model = self.model
+    T = self.token_budget
+    K = self.drafter.k
+    impl = self._paged_impl
+
+    def step(params, kv, tokens, slot_ids, positions, valid, tables,
+             base_last, draft_base, num_draft, active, keys, tok_index,
+             temperature, top_k, top_p):
+      logits, kv = paged_step_logits(model, params, kv, tokens, slot_ids,
+                                     positions, valid, tables, impl=impl)
+      j = jnp.arange(K + 1)[None]                       # [1, K+1]
+      idx = jnp.concatenate(
+          [base_last[:, None],
+           draft_base[:, None] + jnp.arange(K)[None]], axis=1)
+      # Rows past a slot's actual draft count clamp to its own (real,
+      # finite) last row: verification masks them anyway, and the guard
+      # verdict must never convict a slot on another slot's rows.
+      idx = jnp.where(j <= num_draft[:, None], idx, base_last[:, None])
+      idx = jnp.clip(idx, 0, T - 1)
+      tgt = jnp.take(logits, idx, axis=0).astype(jnp.float32)  # [N,K+1,V]
+      dpos = jnp.clip(draft_base[:, None] + jnp.arange(K)[None], 0, T - 1)
+      drafts = jnp.take(tokens, dpos, axis=0)
+      committed, n_committed, accepted = verify_tokens(
+          tgt, drafts, num_draft, keys, tok_index, temperature, top_k,
+          top_p)
+      if not guard:
+        return committed, n_committed, kv
+      slot_ok = jnp.all(jnp.isfinite(tgt), axis=(1, 2)) | ~active
+      return committed, n_committed, slot_ok, kv
+
+    return self._jit_step(step, donate, n_rep_in=14,
+                          n_rep_out=3 if guard else 2, cursors=False)
 
   # ------------------------------------------------------------ host loop
 
@@ -572,19 +716,25 @@ class ContinuousBatchingEngine:
     for slot in np.nonzero(plan.num_valid)[0]:
       slot = int(slot)
       track = self._slot_tracks[slot]
+      extra = {}
+      if self.paged:
+        # Per-request block occupancy in the timeline (report.py rolls
+        # this up as each request's peak KV blocks held).
+        extra["kv_blocks"] = len(self.scheduler.slot_blocks(slot))
       if plan.prefilling[slot]:
         tracer.span_at("prefill", t0_us, t1_us, cat="serving",
                        track=track,
-                       args={"tokens": int(plan.num_valid[slot])})
+                       args={"tokens": int(plan.num_valid[slot]), **extra})
       elif num_draft is not None and int(num_draft[slot]) > 0:
         tracer.span_at(
             "speculate", t0_us, t1_us, cat="serving", track=track,
             args={"drafted": int(num_draft[slot]),
-                  "accepted": int(n_committed[slot]) - 1})
+                  "accepted": int(n_committed[slot]) - 1, **extra})
       else:
         tracer.span_at("decode", t0_us, t1_us, cat="serving",
                        track=track,
-                       args={"tok_index": int(plan.tok_index[slot])})
+                       args={"tok_index": int(plan.tok_index[slot]),
+                             **extra})
 
   def _apply_degradation(self):
     """Feed the ladder this iteration's post-admission load signals and
@@ -607,7 +757,9 @@ class ContinuousBatchingEngine:
     would reject garbage anyway — a flaky drafter may cost speed,
     never correctness), and a degraded ladder (spec_off and above)
     skips draft compute outright — the first ballast under overload."""
-    N = plan.tokens.shape[0]
+    # Per-SLOT count — the paged plan's tokens are flat [token_budget],
+    # so draft_cap (always [num_slots]) carries N for both plan kinds.
+    N = plan.draft_cap.shape[0]
     if not self.scheduler.spec_enabled:
       # getattr: observe_skip postdates the drafter protocol — a
       # duck-typed pre-resilience drafter must not crash the engine the
@@ -629,7 +781,16 @@ class ContinuousBatchingEngine:
         # drafter fault rather than crash the step.
         for slot in np.nonzero(num_draft)[0]:
           nd = int(num_draft[slot])
-          plan.tokens[slot, 1:1 + nd] = draft_tokens[slot, :nd]
+          if self.paged:
+            # Flat layout: drafts land at the slot's reserved draft
+            # positions (scheduler pass 3) and flip exactly those
+            # entries live; unused reservations stay invalid and write
+            # to the null block.
+            b = int(plan.draft_base[slot])
+            plan.tokens[b:b + nd] = draft_tokens[slot, :nd]
+            plan.valid[b:b + nd] = True
+          else:
+            plan.tokens[slot, 1:1 + nd] = draft_tokens[slot, :nd]
       except Exception as e:  # noqa: BLE001 — any drafter fault degrades
         self._drafter_failures += 1
         if not self._drafter_fail_logged:
@@ -668,6 +829,11 @@ class ContinuousBatchingEngine:
     get_logger().warning(
         "bad device step (non-finite logits) on slot(s) %s: %s", bad,
         {s: a for s, a in actions.items()})
+    # Paged: snapshot block lists BEFORE requeue/retire return them to
+    # the pool — the rows must be zeroed either way (the next owner of a
+    # reused block needs the finiteness invariant to hold).
+    blocks_by_slot = ({s: self.scheduler.slot_blocks(s) for s in bad}
+                      if self.paged else None)
     slot_starts: Dict[int, int] = {}
     cursors = None
     for slot, action in actions.items():
@@ -677,11 +843,18 @@ class ContinuousBatchingEngine:
       elif action == BadStepPolicy.FAIL:
         self.scheduler.retire_slot(slot, "failed")
         slot_starts[slot] = 0
+      elif self.paged:
+        # RETRY: the plan's first scheduled position for the slot is the
+        # committed watermark — no device fetch needed (positions are
+        # host-planned in the paged layout).
+        slot_starts[slot] = int(plan.positions[plan.base_idx[slot]])
       else:  # RETRY: zero the bad step's uncommitted writes only.
         if cursors is None:  # host sync on the rare bad-step path only
           cursors = np.asarray(self._cursors)
         slot_starts[slot] = int(cursors[slot])
-    if slot_starts:
+    if slot_starts and self.paged:
+      self._sanitize_paged(slot_starts, blocks_by_slot)
+    elif slot_starts:
       self._sanitize_slots(slot_starts)
     if self.stats is not None:
       # Single source of truth: the policy already counted this event.
@@ -702,6 +875,28 @@ class ContinuousBatchingEngine:
     for slot, row in slot_starts.items():
       mask[slot] = True
       start[slot] = row
+    self._kv = self._sanitize_fn(self._kv, mask, start)
+
+  def _sanitize_paged(self, slot_starts: Dict[int, int],
+                      blocks_by_slot: Dict[int, list]) -> None:
+    """Paged twin of :meth:`_sanitize_slots`: map each poisoned slot's
+    (pre-release) block list to per-block start rows and zero with the
+    same jitted program (dim 0 = pool blocks here).  The null block is
+    always included — a NaN-params step poisons it through the padding
+    writes, and every slot's gather can touch it."""
+    bs = self.block_size
+    mask = np.zeros((self.num_blocks,), bool)
+    start = np.zeros((self.num_blocks,), np.int32)
+    mask[kv_lib.NULL_BLOCK] = True
+    for slot, pos in slot_starts.items():
+      for j, blk in enumerate(blocks_by_slot.get(slot, ())):
+        if (j + 1) * bs <= pos:
+          continue  # wholly below the committed watermark: rows are real
+        row = max(0, pos - j * bs)
+        # A block may appear twice transiently (refcounted sharing later,
+        # ROADMAP item 2): keep the LOWEST start — zeroing more is safe.
+        start[blk] = row if not mask[blk] else min(start[blk], row)
+        mask[blk] = True
     self._kv = self._sanitize_fn(self._kv, mask, start)
 
   def step(self) -> List[FinishedRequest]:
@@ -735,15 +930,29 @@ class ContinuousBatchingEngine:
         # model's mirror call needs the same plan the target sees.
         num_draft = self._propose_drafts(tracer, plan)
         t0_us = tracer.now_us()
-        out = self._step_fn(
-            self.params, self._kv, self._cursors, plan.tokens,
-            plan.num_valid + num_draft, num_draft, plan.reset, plan.keys,
-            plan.tok_index, plan.temperature, plan.top_k, plan.top_p)
-        if self._resilient:
-          committed, n_committed, ok_dev, self._kv, self._cursors = out
-          slot_ok = np.asarray(ok_dev)
+        if self.paged:
+          base_last = (plan.base_idx + plan.num_valid - 1).astype(np.int32)
+          out = self._step_fn(
+              self.params, self._kv, plan.tokens, plan.slot_ids,
+              plan.positions, plan.valid, plan.block_tables, base_last,
+              plan.draft_base, num_draft, plan.num_valid > 0, plan.keys,
+              plan.tok_index, plan.temperature, plan.top_k, plan.top_p)
+          if self._resilient:
+            committed, n_committed, ok_dev, self._kv = out
+            slot_ok = np.asarray(ok_dev)
+          else:
+            committed, n_committed, self._kv = out
         else:
-          committed, n_committed, self._kv, self._cursors = out
+          out = self._step_fn(
+              self.params, self._kv, self._cursors, plan.tokens,
+              plan.num_valid + num_draft, num_draft, plan.reset,
+              plan.keys, plan.tok_index, plan.temperature, plan.top_k,
+              plan.top_p)
+          if self._resilient:
+            committed, n_committed, ok_dev, self._kv, self._cursors = out
+            slot_ok = np.asarray(ok_dev)
+          else:
+            committed, n_committed, self._kv, self._cursors = out
         committed = np.asarray(committed)
         n_committed = np.asarray(n_committed)
         t1_us = tracer.now_us()
@@ -766,15 +975,28 @@ class ContinuousBatchingEngine:
         accepted = int((n_committed[speculated] - 1).sum())
       else:
         t0_us = tracer.now_us()
-        out = self._step_fn(
-            self.params, self._kv, self._cursors, plan.tokens,
-            plan.num_valid, plan.reset, plan.keys, plan.tok_index,
-            plan.temperature, plan.top_k, plan.top_p)
-        if self._resilient:
-          nxt, ok_dev, self._kv, self._cursors = out
-          slot_ok = np.asarray(ok_dev)
+        if self.paged:
+          last_idx = (plan.base_idx + plan.num_valid - 1).astype(np.int32)
+          out = self._step_fn(
+              self.params, self._kv, plan.tokens, plan.slot_ids,
+              plan.positions, plan.valid, plan.block_tables, last_idx,
+              plan.num_valid > 0, plan.keys, plan.tok_index,
+              plan.temperature, plan.top_k, plan.top_p)
+          if self._resilient:
+            nxt, ok_dev, self._kv = out
+            slot_ok = np.asarray(ok_dev)
+          else:
+            nxt, self._kv = out
         else:
-          nxt, self._kv, self._cursors = out
+          out = self._step_fn(
+              self.params, self._kv, self._cursors, plan.tokens,
+              plan.num_valid, plan.reset, plan.keys, plan.tok_index,
+              plan.temperature, plan.top_k, plan.top_p)
+          if self._resilient:
+            nxt, ok_dev, self._kv, self._cursors = out
+            slot_ok = np.asarray(ok_dev)
+          else:
+            nxt, self._kv, self._cursors = out
         nxt = np.asarray(nxt)
         t1_us = tracer.now_us()
         tracer.span_at("serving/device_step", t0_us, t1_us,
@@ -804,6 +1026,13 @@ class ContinuousBatchingEngine:
       dc_tokens = int((ok & ~plan.prefilling).sum())
     if tracer.enabled:
       tracer.counter("serving/active_slots", plan.active_slots)
+      if self.paged:
+        # Block-pool occupancy rides the counter tracks next to
+        # active_slots, so Perfetto shows pool pressure against load.
+        tracer.counter("serving/kv_blocks_used",
+                       self.scheduler.kv_blocks_used)
+        tracer.counter("serving/kv_blocks_free",
+                       self.scheduler.kv_blocks_free)
       if drafted:
         tracer.counter("serving/drafted_tokens", drafted)
         tracer.counter("serving/accepted_tokens", accepted)
@@ -813,6 +1042,11 @@ class ContinuousBatchingEngine:
           prefill_tokens=pf_tokens,
           decode_tokens=dc_tokens, step_time_s=dt,
           drafted_tokens=drafted, accepted_tokens=accepted)
+      if self.paged:
+        self.stats.note_blocks(self.scheduler.kv_blocks_free,
+                               self.scheduler.kv_blocks_used,
+                               self.scheduler.kv_fragmentation,
+                               self.scheduler.preemptions)
     if self.metrics_writer is not None or self.registry is not None:
       record = {
           "active_slots": plan.active_slots,
@@ -821,6 +1055,14 @@ class ContinuousBatchingEngine:
           "decode_tokens": dc_tokens,
           "step_time_s": dt,
       }
+      if self.paged:
+        # The block-pool gauges (ROADMAP item 1 satellite): pool
+        # occupancy, internal fragmentation, and preemption count under
+        # the serving/* schema.
+        record["kv_blocks_free"] = self.scheduler.kv_blocks_free
+        record["kv_blocks_used"] = self.scheduler.kv_blocks_used
+        record["kv_fragmentation"] = self.scheduler.kv_fragmentation
+        record["preemptions"] = self.scheduler.preemptions
       if self.drafter is not None:
         record["drafted_tokens"] = drafted
         record["accepted_tokens"] = accepted

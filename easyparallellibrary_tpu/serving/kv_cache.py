@@ -1,10 +1,17 @@
-"""Slot-based KV cache for continuous-batching inference.
+"""KV cache layouts for continuous-batching inference — contiguous
+slots and the paged block pool.
 
-The serving engine's memory plan is vLLM's insight shrunk to one level:
-instead of allocating a fresh ``[B, max_seq_len, H, hd]`` cache per
-``generate()`` call (models/gpt.py legacy decode), ONE cache of
-``num_slots`` request slots is allocated at engine start and reused for
-the life of the server.  A slot is the unit of admission: a request owns
+Two memory plans share this module.  The CONTIGUOUS layout is vLLM's
+insight shrunk to one level: instead of allocating a fresh
+``[B, max_seq_len, H, hd]`` cache per ``generate()`` call (models/gpt.py
+legacy decode), ONE cache of ``num_slots`` request slots is allocated at
+engine start and reused for the life of the server.  The PAGED layout
+(``serving.paged.*``; docs/serving.md "Paged KV cache") is the full
+two-level design: K/V lives in a pool of fixed-size blocks
+(:func:`allocate_paged_kv_cache`), each slot owns a grown-on-demand
+block list behind an on-device block table, and a host-side
+:class:`BlockAllocator` (free list + refcounts) turns retired requests'
+worst-case tail reservations into extra concurrent requests.  A slot is the unit of admission: a request owns
 exactly one slot from admission to retirement, its write offset tracked
 by a per-slot cursor (the cursor *vector* models/gpt.py's
 ``slot_cache_attend`` consumes).  Eviction is free-list bookkeeping on
@@ -31,6 +38,7 @@ and corrupt earlier positions.
 
 from __future__ import annotations
 
+import heapq
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -38,6 +46,16 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from easyparallellibrary_tpu import constants
+
+# Pool index of the reserved null/trash block: block tables default-fill
+# with it (unallocated table slots resolve there), and the fused step's
+# padding-token writes land there.  Never handed out by BlockAllocator;
+# its rows are garbage-but-FINITE by construction (they only ever receive
+# real projection outputs), which is all slot/paged attention requires of
+# unattendable rows — and the resilient engine's sanitize pass zeroes it
+# alongside any poisoned slot, since a NaN-params step poisons padding
+# writes too.
+NULL_BLOCK = 0
 
 
 def cache_length(cfg, chunk: int) -> int:
@@ -115,6 +133,154 @@ def cache_bytes(cfg, num_slots: int, chunk: int) -> int:
   H, hd = cfg.num_heads, cfg.d_model // cfg.num_heads
   per_leaf = num_slots * cache_length(cfg, chunk) * H * hd
   return 2 * cfg.num_layers * per_leaf * jnp.dtype(cfg.dtype).itemsize
+
+
+# ------------------------------------------------------------ paged cache --
+
+
+def blocks_per_slot(cfg, block_size: int) -> int:
+  """Block-table width: virtual context rows per slot == ``max_seq_len``
+  exactly.  ``block_size`` must divide ``max_seq_len``: the paged
+  attend's softmax/V reductions then run over the SAME length as the
+  ``generate(use_cache=True)`` oracle's cache, which is what keeps the
+  paged engine greedy bit-exact (a longer padded length regroups XLA's
+  vectorized partial sums — measured 1-ulp drift — even though the tail
+  terms are exact zeros)."""
+  if block_size < 1:
+    raise ValueError(f"block_size must be >= 1: {block_size}")
+  if cfg.max_seq_len % block_size:
+    raise ValueError(
+        f"serving.paged.block_size {block_size} must divide max_seq_len "
+        f"{cfg.max_seq_len}: the paged attend's reduction length "
+        f"(blocks_per_slot * block_size) must equal the oracle's cache "
+        f"length for the greedy bit-exactness contract to hold")
+  return cfg.max_seq_len // block_size
+
+
+def default_num_blocks(cfg, num_slots: int, block_size: int) -> int:
+  """Auto pool size: every slot can reach ``max_seq_len`` (plus the null
+  block) — byte-parity with the contiguous layout, so enabling paging is
+  never a capacity REGRESSION by default.  The memory win is opt-in:
+  size ``serving.paged.num_blocks`` below this (or raise ``num_slots``
+  above the contiguous budget) and on-demand allocation turns unused
+  tail capacity into extra concurrent requests."""
+  return num_slots * blocks_per_slot(cfg, block_size) + 1
+
+
+def allocate_paged_kv_cache(cfg, num_blocks: int, block_size: int,
+                            mesh: Optional[Mesh] = None) -> Dict[str, Any]:
+  """Preallocate the paged K/V pools for a GPT config.
+
+  Returns the ``"cache"``-collection pytree GPT's paged decode
+  reads/writes: ``{"block_i": {"attn": {"cached_key"/"cached_value":
+  [num_blocks, block_size, H, hd]}}}``.  Heads sit at the same axis
+  index as the slot layout, so :func:`kv_cache_shardings` serves both.
+  Block ``NULL_BLOCK`` is the reserved trash block (module constant).
+  """
+  mb = blocks_per_slot(cfg, block_size)
+  if num_blocks < mb + 1:
+    raise ValueError(
+        f"num_blocks {num_blocks} cannot hold even one full-length "
+        f"request: need >= blocks_per_slot + 1 = {mb + 1} (one null "
+        f"block plus max_seq_len/block_size per request)")
+  if cfg.d_model % cfg.num_heads:
+    raise ValueError(f"d_model {cfg.d_model} must divide into "
+                     f"{cfg.num_heads} heads")
+  H, hd = cfg.num_heads, cfg.d_model // cfg.num_heads
+  shape = (num_blocks, block_size, H, hd)
+  kv_shardings, _ = kv_cache_shardings(cfg, mesh)
+
+  def build():
+    leaf = lambda: jnp.zeros(shape, cfg.dtype)
+    return {f"block_{i}": {"attn": {"cached_key": leaf(),
+                                    "cached_value": leaf()}}
+            for i in range(cfg.num_layers)}
+
+  if kv_shardings is None:
+    return jax.jit(build)()
+  return jax.jit(build, out_shardings=kv_shardings)()
+
+
+def paged_cache_bytes(cfg, num_blocks: int, block_size: int) -> int:
+  """Paged-pool footprint in bytes (both K and V, all layers) — the
+  paged twin of :func:`cache_bytes`, and the number the long-tail
+  benchmark holds fixed while raising concurrency."""
+  H, hd = cfg.num_heads, cfg.d_model // cfg.num_heads
+  per_leaf = num_blocks * block_size * H * hd
+  return 2 * cfg.num_layers * per_leaf * jnp.dtype(cfg.dtype).itemsize
+
+
+class BlockAllocator:
+  """Host-side free-list + refcounts over the paged K/V pool.
+
+  Lowest-free-first (a heap) keeps block assignment deterministic for a
+  given request order, mirroring :class:`SlotAllocator`.  Refcounts are
+  carried NOW — every block currently holds exactly one reference — so
+  copy-on-write prefix sharing (ROADMAP item 2) can later share a block
+  between slots by increffing instead of copying; ``decref`` returns the
+  block to the free list only at zero.  Block ``NULL_BLOCK`` is reserved
+  and never allocated.
+  """
+
+  def __init__(self, num_blocks: int, block_size: int):
+    if num_blocks < 2:
+      raise ValueError(f"num_blocks must be >= 2 (one null block plus at "
+                       f"least one allocatable): {num_blocks}")
+    if block_size < 1:
+      raise ValueError(f"block_size must be >= 1: {block_size}")
+    self.num_blocks = num_blocks
+    self.block_size = block_size
+    self._free: List[int] = list(range(1, num_blocks))
+    heapq.heapify(self._free)
+    self._ref: Dict[int, int] = {}
+
+  @property
+  def num_free(self) -> int:
+    return len(self._free)
+
+  @property
+  def num_used(self) -> int:
+    return len(self._ref)
+
+  def alloc(self) -> Optional[int]:
+    """Claim the lowest free block at refcount 1, or None when empty."""
+    if not self._free:
+      return None
+    blk = heapq.heappop(self._free)
+    self._ref[blk] = 1
+    return blk
+
+  def incref(self, block: int) -> None:
+    """Add a reference (future copy-on-write sharing: ROADMAP item 2)."""
+    if block not in self._ref:
+      raise ValueError(f"block {block} is not allocated")
+    self._ref[block] += 1
+
+  def decref(self, block: int) -> None:
+    """Drop a reference; the block returns to the free list at zero."""
+    if block not in self._ref:
+      raise ValueError(f"block {block} is not allocated (double free?)")
+    self._ref[block] -= 1
+    if self._ref[block] == 0:
+      del self._ref[block]
+      heapq.heappush(self._free, block)
+
+  def refcount(self, block: int) -> int:
+    return self._ref.get(block, 0)
+
+  def fragmentation(self, used_tokens: int) -> float:
+    """Internal fragmentation: the fraction of allocated token capacity
+    no resident token occupies (last-block slack across slots).  0.0
+    when nothing is allocated."""
+    cap = self.num_used * self.block_size
+    if cap <= 0:
+      return 0.0
+    return max(0.0, 1.0 - used_tokens / cap)
+
+  def __repr__(self):
+    return (f"BlockAllocator(num_blocks={self.num_blocks}, "
+            f"block_size={self.block_size}, free={self.num_free}, "
+            f"used={self.num_used})")
 
 
 class SlotAllocator:

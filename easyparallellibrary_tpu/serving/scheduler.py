@@ -50,6 +50,7 @@ import numpy as np
 from easyparallellibrary_tpu.observability import trace as trace_lib
 from easyparallellibrary_tpu.serving._capabilities import (
     check_request_fields)
+from easyparallellibrary_tpu.utils.logging import get_logger
 
 
 def _slot_track(slot: int) -> str:
@@ -123,6 +124,36 @@ class StepPlan:
   active_slots: int
 
 
+@dataclasses.dataclass
+class PagedStepPlan:
+  """Device-ready arrays for one token-flat fused step over the paged
+  cache (serving/engine.py paged mode).  Flat arrays are [T] —
+  ``T = token_budget``, one entry per scheduled position, each tagged
+  with its slot and absolute position; per-slot arrays are [N] and share
+  :class:`StepPlan`'s semantics so ``commit()`` consumes both plan kinds
+  unchanged (``num_valid`` counts a slot's REAL tokens this step — its
+  prefill grant, or 1 for decode — never reserved draft positions)."""
+  tokens: np.ndarray          # int32 [T]  flat token batch
+  slot_ids: np.ndarray        # int32 [T]  owning slot per position
+  positions: np.ndarray       # int32 [T]  absolute position per token
+  valid: np.ndarray           # bool  [T]  live entry (drafts flip late)
+  block_tables: np.ndarray    # int32 [N, MB] per-slot block tables
+  base_idx: np.ndarray        # int32 [N]  slot's first flat index
+  draft_base: np.ndarray      # int32 [N]  slot's first draft flat index
+  num_valid: np.ndarray       # int32 [N]  real tokens scheduled (no drafts)
+  draft_cap: np.ndarray       # int32 [N]  reserved draft positions
+  prefilling: np.ndarray      # bool  [N]
+  keys: np.ndarray            # uint32 [N, 2]
+  tok_index: np.ndarray       # int32 [N]
+  temperature: np.ndarray     # f32   [N]
+  top_k: np.ndarray           # int32 [N]
+  top_p: np.ndarray           # f32   [N]
+  prefill_tokens: int
+  decode_tokens: int
+  scheduled_tokens: int       # live flat positions (diagnostics)
+  active_slots: int
+
+
 class _SlotState:
   """Host mirror of one occupied slot.
 
@@ -133,16 +164,24 @@ class _SlotState:
 
   __slots__ = ("req", "slot", "prompt_pos", "generated", "key", "prefix",
                "submitted_at", "admitted_at", "first_token_at",
-               "first_token_emitted", "requeues", "bad_streak")
+               "first_token_emitted", "requeues", "bad_streak",
+               "admit_seq")
 
   def __init__(self, req: Request, slot: int, submitted_at: float,
-               now: float, carried: Optional["_SlotState"] = None):
+               now: float, carried: Optional["_SlotState"] = None,
+               admit_seq: int = 0):
     self.req = req
     self.slot = slot
     self.prompt_pos = 0                    # prefix tokens already fed
     self.submitted_at = submitted_at
     self.admitted_at = now
     self.bad_streak = 0                    # consecutive bad-step hits
+    # Monotonic admission sequence (preemption eligibility: a slot may
+    # only page out strictly-younger same-priority slots, so two
+    # starving slots can never preempt each other in a cycle).  A
+    # requeued request gets a FRESH seq on readmission — it re-enters as
+    # the youngest and cannot immediately steal back its old blocks.
+    self.admit_seq = admit_seq
     if carried is not None:
       self.generated: List[int] = carried.generated
       self.key = carried.key
@@ -226,8 +265,11 @@ class FCFSScheduler:
   def __init__(self, num_slots: int, prefill_chunk: int,
                max_seq_len: int, prefill_token_budget: int = 0,
                max_batch: int = 0, stop_token: int = -1,
-               spec_k: int = 0, clock: Callable[[], float] = time.monotonic):
-    from easyparallellibrary_tpu.serving.kv_cache import SlotAllocator
+               spec_k: int = 0, clock: Callable[[], float] = time.monotonic,
+               block_size: int = 0, num_blocks: int = 0,
+               token_budget: int = 0):
+    from easyparallellibrary_tpu.serving.kv_cache import (
+        BlockAllocator, SlotAllocator)
     if prefill_chunk < 1:
       raise ValueError(f"prefill_chunk must be >= 1: {prefill_chunk}")
     if prefill_token_budget < 0 or max_batch < 0:
@@ -237,6 +279,36 @@ class FCFSScheduler:
     self.num_slots = num_slots
     self.chunk = prefill_chunk
     self.max_seq_len = max_seq_len
+    # Paged mode (block_size > 0): plan_step builds token-flat
+    # PagedStepPlans against a block-table cache; the per-slot K/V
+    # region becomes a grown-on-demand block list and pool exhaustion
+    # preempts instead of raising (engine: serving.paged.*).
+    self.paged = block_size > 0
+    if self.paged:
+      if max_seq_len % block_size:
+        raise ValueError(f"block_size {block_size} must divide "
+                         f"max_seq_len {max_seq_len}")
+      if token_budget < 1:
+        raise ValueError(f"token_budget must be >= 1 in paged mode: "
+                         f"{token_budget}")
+      eff_batch = min(num_slots, max_batch if max_batch > 0 else num_slots)
+      if token_budget < eff_batch:
+        raise ValueError(
+            f"token_budget {token_budget} below the concurrent-batch cap "
+            f"{eff_batch}: a step could not hand every decoding slot its "
+            f"one guaranteed token")
+      self.block_size = block_size
+      self.token_budget = token_budget
+      self._mb = max_seq_len // block_size
+      self.block_allocator = BlockAllocator(num_blocks, block_size)
+      self._slot_blocks: Dict[int, List[int]] = {}
+      self._tables = np.zeros((num_slots, self._mb), np.int32)
+      self.preemptions = 0
+    else:
+      self.block_size = 0
+      self.token_budget = 0
+      self.block_allocator = None
+    self._admit_seq = 0
     # Max speculative drafts per decode slot per step (0 = engine has no
     # drafter); per-request Request.speculative=False opts out, and the
     # engine's degradation ladder flips `spec_enabled` off under load.
@@ -438,6 +510,7 @@ class FCFSScheduler:
     del self.active[slot]
     self._admit_order.remove(slot)
     self.allocator.free(slot)
+    self._release_blocks(slot)
     self._deadline_active -= self._has_deadline(state.req)
     state.requeues += 1
     state.bad_streak = 0
@@ -507,8 +580,10 @@ class FCFSScheduler:
       self._deadline_pending -= self._has_deadline(entry.req)
       req = entry.req
       slot = self.allocator.alloc()
+      self._admit_seq += 1
       state = _SlotState(req, slot, entry.submitted_at, self.clock(),
-                         carried=entry.carried)
+                         carried=entry.carried,
+                         admit_seq=self._admit_seq)
       self.active[slot] = state
       self._deadline_active += self._has_deadline(req)
       self._admit_order.append(slot)
@@ -529,6 +604,243 @@ class FCFSScheduler:
         for fn in self.on_admit:
           fn(req.uid)
 
+  # -------------------------------------------------- paged block planning
+
+  def _resident_tokens(self, state: _SlotState) -> int:
+    """Tokens whose K/V are valid-resident in the slot's blocks — the
+    host mirror of the contiguous engine's device cursor.  During
+    prefill this is the fed prefix; after it, the decode input token's
+    position is always ``len(prompt) + len(generated) - 1`` (a requeued
+    replay's generated prefix is both inside ``prefix`` AND in
+    ``generated``, which this accounting absorbs)."""
+    if state.prefilling:
+      return state.prompt_pos
+    return len(state.req.prompt) + len(state.generated) - 1
+
+  def slot_blocks(self, slot: int) -> List[int]:
+    """The slot's current block list (engine sanitize + tests)."""
+    return list(self._slot_blocks.get(slot, ()))
+
+  @property
+  def kv_blocks_free(self) -> int:
+    return self.block_allocator.num_free if self.paged else 0
+
+  @property
+  def kv_blocks_used(self) -> int:
+    return self.block_allocator.num_used if self.paged else 0
+
+  @property
+  def kv_fragmentation(self) -> float:
+    """Fraction of allocated block capacity no resident token occupies
+    (last-block slack + preallocated draft headroom)."""
+    if not self.paged:
+      return 0.0
+    used_tokens = sum(self._resident_tokens(s)
+                      for s in self.active.values())
+    return self.block_allocator.fragmentation(used_tokens)
+
+  def _release_blocks(self, slot: int) -> None:
+    if not self.paged:
+      return
+    for blk in self._slot_blocks.pop(slot, ()):  # noqa: B909
+      self.block_allocator.decref(blk)
+    self._tables[slot] = 0
+
+  def _preempt_for_blocks(self, requester: int,
+                          scheduled: set) -> Optional[int]:
+    """Page out one victim to refill the pool (satellite of ROADMAP
+    item 1: exhaustion preempts instead of raising).  Victim choice:
+    lowest priority class first, then the youngest admission — the
+    least-progress slot loses.  A victim must be strictly younger (or
+    lower-priority) than the requester and must not already hold
+    scheduled work in the plan being built (its in-flight writes would
+    race the reallocated blocks).  Returns the victim slot or None."""
+    req_state = self.active.get(requester)
+    if req_state is None:
+      return None
+    req_rank = (req_state.req.priority == "latency", -req_state.admit_seq)
+    best = None
+    best_rank = None
+    for slot, state in self.active.items():
+      if slot == requester or slot in scheduled:
+        continue
+      if not self._slot_blocks.get(slot):
+        # A blockless victim frees nothing: evicting it would requeue a
+        # request (and burn its queue position) without refilling the
+        # pool — the requester must starve instead.
+        continue
+      rank = (state.req.priority == "latency", -state.admit_seq)
+      if rank >= req_rank:
+        continue  # only strictly lower-priority-or-younger slots
+      if best is None or rank < best_rank:
+        best, best_rank = slot, rank
+    if best is None:
+      return None
+    uid = self.active[best].req.uid
+    self.preemptions += 1
+    get_logger().warning(
+        "KV block pool exhausted: preempting slot %d (uid %r) to refill "
+        "it; the request replays its committed prefix on readmission",
+        best, uid)
+    self.requeue_slot(best, reason="preempted")
+    return best
+
+  def _ensure_blocks(self, slot: int, num_tokens: int, scheduled: set,
+                     preempt: bool = True) -> int:
+    """Grow ``slot``'s block list to cover ``num_tokens`` positions,
+    preempting victims when the pool runs dry (``preempt=False`` for
+    optional work — speculative draft headroom must never evict a
+    request's committed K/V).  Returns the number of positions actually
+    covered (callers shrink their grant to it — a short allocation
+    starves the slot for a step, never corrupts)."""
+    blocks = self._slot_blocks.setdefault(slot, [])
+    need = min((num_tokens + self.block_size - 1) // self.block_size,
+               self._mb)
+    while len(blocks) < need:
+      blk = self.block_allocator.alloc()
+      if blk is None:
+        if not preempt or self._preempt_for_blocks(slot, scheduled) is None:
+          break
+        continue
+      self._tables[slot, len(blocks)] = blk
+      blocks.append(blk)
+    return min(len(blocks) * self.block_size, self.max_seq_len)
+
+  def _plan_flat(self) -> Optional[PagedStepPlan]:
+    """Token-budget planning: the paged twin of the slot-block half of
+    :meth:`plan_step`.  Three passes over admission order fill the flat
+    batch: (1) every decoding slot gets its one guaranteed token (ITL
+    protection — ``token_budget >= max_batch`` is validated so this pass
+    never starves), (2) prefill chunks stream in while the flat budget
+    and the prefill-token budget allow, (3) leftover budget is reserved
+    for speculative drafts (drafts ride spare capacity here, exactly as
+    they ride wasted chunk positions in the slot engine).  Block
+    coverage is ensured per grant; a dry pool preempts the youngest
+    lowest-priority slot, and a still-short allocation shrinks the grant
+    (the slot resumes next step)."""
+    if not self.active:
+      self._plan = None
+      return None
+    T, N, MB = self.token_budget, self.num_slots, self._mb
+    plan = PagedStepPlan(
+        tokens=np.zeros((T,), np.int32),
+        slot_ids=np.zeros((T,), np.int32),
+        positions=np.zeros((T,), np.int32),
+        valid=np.zeros((T,), bool),
+        block_tables=np.zeros((N, MB), np.int32),
+        base_idx=np.zeros((N,), np.int32),
+        draft_base=np.zeros((N,), np.int32),
+        num_valid=np.zeros((N,), np.int32),
+        draft_cap=np.zeros((N,), np.int32),
+        prefilling=np.zeros((N,), bool),
+        keys=np.zeros((N, 2), np.uint32),
+        tok_index=np.zeros((N,), np.int32),
+        temperature=np.zeros((N,), np.float32),
+        top_k=np.zeros((N,), np.int32),
+        top_p=np.ones((N,), np.float32),
+        prefill_tokens=0, decode_tokens=0, scheduled_tokens=0,
+        active_slots=len(self.active))
+    budget = self._effective_budget()
+    pos = 0
+    scheduled: set = set()
+    # Pass 1: decode slots — one guaranteed token each.
+    for slot in list(self._admit_order):
+      state = self.active.get(slot)
+      if state is None or state.prefilling:
+        continue
+      dec_pos = self._resident_tokens(state)
+      if self._ensure_blocks(slot, dec_pos + 1, scheduled) < dec_pos + 1:
+        continue  # pool exhausted with no eligible victim: starve a step
+      state = self.active.get(slot)
+      if state is None:
+        continue  # defensive: a preemption cascade evicted this slot
+      plan.base_idx[slot] = pos
+      plan.tokens[pos] = state.generated[-1]
+      plan.slot_ids[pos] = slot
+      plan.positions[pos] = dec_pos
+      plan.valid[pos] = True
+      plan.num_valid[slot] = 1
+      plan.decode_tokens += 1
+      pos += 1
+      scheduled.add(slot)
+    # Pass 2: prefill chunks under both budgets.
+    for slot in list(self._admit_order):
+      state = self.active.get(slot)
+      if state is None or not state.prefilling or pos >= T:
+        continue
+      remaining = len(state.prefix) - state.prompt_pos
+      grant = min(self.chunk, remaining, T - pos)
+      if budget > 0:
+        grant = min(grant, max(budget - plan.prefill_tokens, 0))
+      if grant <= 0:
+        continue
+      covered = self._ensure_blocks(slot, state.prompt_pos + grant,
+                                    scheduled)
+      grant = min(grant, covered - state.prompt_pos)
+      state = self.active.get(slot)
+      if state is None or grant <= 0:
+        continue
+      chunk = state.prefix[state.prompt_pos:state.prompt_pos + grant]
+      plan.base_idx[slot] = pos
+      plan.tokens[pos:pos + grant] = chunk
+      plan.slot_ids[pos:pos + grant] = slot
+      plan.positions[pos:pos + grant] = np.arange(
+          state.prompt_pos, state.prompt_pos + grant)
+      plan.valid[pos:pos + grant] = True
+      plan.num_valid[slot] = grant
+      plan.prefilling[slot] = True
+      plan.prefill_tokens += grant
+      pos += grant
+      scheduled.add(slot)
+    # Pass 3: speculative draft reservations ride the leftover budget.
+    if self.spec_k > 0 and self.spec_enabled:
+      for slot in list(self._admit_order):
+        state = self.active.get(slot)
+        if (state is None or state.prefilling
+            or plan.num_valid[slot] != 1 or pos >= T
+            or state.req.speculative is False):
+          continue
+        remaining = state.req.max_new_tokens - len(state.generated)
+        cap = max(0, min(self.spec_k, remaining - 1, T - pos))
+        if cap <= 0:
+          continue
+        dec_pos = int(plan.positions[plan.base_idx[slot]])
+        # Draft headroom is OPTIONAL work: never preempt for it — a dry
+        # pool just shrinks the draft cap (drafts ride spare capacity).
+        covered = self._ensure_blocks(slot, dec_pos + 1 + cap, scheduled,
+                                      preempt=False)
+        cap = max(0, min(cap, covered - 1 - dec_pos))
+        if cap <= 0 or self.active.get(slot) is None:
+          continue
+        plan.draft_base[slot] = pos
+        plan.slot_ids[pos:pos + cap] = slot
+        plan.positions[pos:pos + cap] = np.arange(dec_pos + 1,
+                                                  dec_pos + 1 + cap)
+        # valid stays False: the engine flips exactly the positions the
+        # drafter fills (serving/engine.py _propose_drafts).
+        plan.draft_cap[slot] = cap
+        pos += cap
+    # Per-slot sampling state for every slot with scheduled work.
+    for slot in self._admit_order:
+      state = self.active.get(slot)
+      if state is None or plan.num_valid[slot] == 0:
+        continue
+      req = state.req
+      plan.keys[slot] = state.key
+      plan.tok_index[slot] = len(state.generated)
+      plan.temperature[slot] = req.temperature
+      plan.top_k[slot] = req.top_k
+      plan.top_p[slot] = req.top_p
+    plan.scheduled_tokens = pos
+    plan.block_tables = self._tables.copy()
+    if pos == 0:
+      # Every active slot starved (pool exhausted, budget zero): no
+      # device work this iteration.
+      self._plan = None
+      return None
+    self._plan = plan
+    return plan
+
   def plan_step(self) -> Optional[StepPlan]:
     """Build the next fused step's inputs, or None when idle.
 
@@ -541,6 +853,8 @@ class FCFSScheduler:
     """
     self.expire()
     self._admit()
+    if self.paged:
+      return self._plan_flat()
     if not self.active:
       self._plan = None
       return None
@@ -616,6 +930,7 @@ class FCFSScheduler:
     del self.active[slot]
     self._admit_order.remove(slot)
     self.allocator.free(slot)
+    self._release_blocks(slot)
     self._deadline_active -= self._has_deadline(state.req)
     tracer = trace_lib.get_tracer()
     if tracer.enabled:

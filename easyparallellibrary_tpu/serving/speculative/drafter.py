@@ -127,7 +127,9 @@ class NgramDrafter(Drafter):
 
   def propose(self, plan, histories):
     from easyparallellibrary_tpu.observability import trace as trace_lib
-    N = plan.tokens.shape[0]
+    # draft_cap is per-SLOT in both plan kinds (the paged plan's tokens
+    # are a flat [token_budget] batch, so tokens.shape[0] is not N).
+    N = plan.draft_cap.shape[0]
     toks = np.zeros((N, self.k), np.int32)
     counts = np.zeros((N,), np.int32)
     with trace_lib.get_tracer().span("ngram_propose", cat="serving",
@@ -166,6 +168,11 @@ class DraftModelDrafter(Drafter):
     self._kv = None
     self._cursors = None
     self._fn = None
+    # Paged-engine mirror (set at bind): the draft model keeps its OWN
+    # paged pools but reads the ENGINE's block tables — block indices
+    # depend only on positions, which are identical on both sides, so
+    # one host allocation serves both caches.
+    self._paged = False
 
   @classmethod
   def from_checkpoint(cls, directory: str, model, *, k: int = 4,
@@ -203,9 +210,25 @@ class DraftModelDrafter(Drafter):
     from easyparallellibrary_tpu.serving import kv_cache as kv_lib
     check_draft_compatible(engine.model.cfg, self.model.cfg)
     mesh = self.mesh if self.mesh is not None else engine.mesh
-    self._kv, self._cursors = kv_lib.allocate_kv_cache(
-        self.model.cfg, engine.num_slots, engine.chunk, mesh)
-    self._fn = self._build_draft_fn(engine.chunk)
+    self._paged = bool(getattr(engine, "paged", False))
+    if self._paged:
+      import dataclasses
+      # The mirror pool is addressed exclusively through the ENGINE's
+      # block tables (target max_seq_len / block_size wide), so its
+      # capacity/geometry validation must use the TARGET's sequence
+      # length — a draft model legitimately padded LONGER than the
+      # target (check_draft_compatible permits and even advises it)
+      # must not inflate the blocks-per-slot requirement.  Only the
+      # draft's head geometry shapes the pool.
+      mirror_cfg = dataclasses.replace(
+          self.model.cfg, max_seq_len=engine.model.cfg.max_seq_len)
+      self._kv = kv_lib.allocate_paged_kv_cache(
+          mirror_cfg, engine.num_blocks, engine.block_size, mesh)
+      self._fn = self._build_paged_draft_fn(engine)
+    else:
+      self._kv, self._cursors = kv_lib.allocate_kv_cache(
+          self.model.cfg, engine.num_slots, engine.chunk, mesh)
+      self._fn = self._build_draft_fn(engine.chunk)
 
   def _build_draft_fn(self, chunk: int):
     from easyparallellibrary_tpu.models.gpt import slot_step_logits
@@ -235,6 +258,45 @@ class DraftModelDrafter(Drafter):
 
     return jax.jit(draft, donate_argnums=(1,))
 
+  def _build_paged_draft_fn(self, engine):
+    """Paged twin of :meth:`_build_draft_fn`: mirror the engine's FLAT
+    plan through the draft model (same tokens, slots, positions and
+    block tables — prefill chunks keep the mirror pools in lockstep),
+    then greedily roll ``k`` tokens ahead per drafting slot with
+    one-token-per-slot flat batches at consecutive positions.  Rollout
+    positions past the virtual length clamp to the null block inside
+    ``paged_step_logits``, so overshoot (a slot near its budget) costs
+    acceptance, never correctness.  No cursors anywhere: rollback is
+    implicit in next step's host-planned positions."""
+    from easyparallellibrary_tpu.models.gpt import paged_step_logits
+    model, K = self.model, self.k
+    N = engine.num_slots
+    T = engine.token_budget
+    impl = engine._paged_impl
+
+    def draft(params, kv, tokens, slot_ids, positions, valid, tables,
+              last_idx, drafting):
+      li = jnp.clip(last_idx, 0, T - 1)
+      logits, kv = paged_step_logits(model, params, kv, tokens, slot_ids,
+                                     positions, valid, tables, impl=impl)
+      last = jnp.take(logits, li, axis=0)                 # [N, V]
+      toks = [jnp.argmax(last, axis=-1).astype(jnp.int32)]
+      sid = jnp.arange(N, dtype=jnp.int32)
+      pos0 = jnp.take(positions, li, axis=0) + 1          # first draft pos
+      for j in range(1, K):
+        lg, kv = paged_step_logits(model, params, kv, toks[-1], sid,
+                                   pos0 + (j - 1), drafting, tables,
+                                   impl=impl)
+        toks.append(jnp.argmax(lg, axis=-1).astype(jnp.int32))
+      # Write-only feed of the final draft (same contract as the slot
+      # layout: full acceptance must leave no K/V hole).
+      _, kv = paged_step_logits(model, params, kv, toks[-1], sid,
+                                pos0 + (K - 1), drafting, tables,
+                                impl=impl)
+      return jnp.stack(toks, axis=1), kv
+
+    return jax.jit(draft, donate_argnums=(1,))
+
   def propose(self, plan, histories):
     from easyparallellibrary_tpu.observability import trace as trace_lib
     if self._fn is None:
@@ -242,8 +304,15 @@ class DraftModelDrafter(Drafter):
                          "engine binds drafters in its constructor")
     with trace_lib.get_tracer().span("draft_model_forward", cat="serving",
                                      track="serving"):
-      toks, self._kv = self._fn(self.params, self._kv, self._cursors,
-                                plan.tokens, plan.num_valid, plan.reset)
+      if self._paged:
+        last_idx = (plan.base_idx + plan.num_valid - 1).astype(np.int32)
+        toks, self._kv = self._fn(
+            self.params, self._kv, plan.tokens, plan.slot_ids,
+            plan.positions, plan.valid, plan.block_tables, last_idx,
+            plan.draft_cap > 0)
+      else:
+        toks, self._kv = self._fn(self.params, self._kv, self._cursors,
+                                  plan.tokens, plan.num_valid, plan.reset)
       toks = np.asarray(toks)
     counts = np.minimum(plan.draft_cap, self.k).astype(np.int32)
     return toks, counts
@@ -253,7 +322,10 @@ class DraftModelDrafter(Drafter):
     # for draft and target caches, so adopting the engine's rolled-back
     # vector IS the draft-side rollback (rejected-draft K/V beyond it is
     # masked, then overwritten, exactly like chunked-prefill garbage).
-    self._cursors = new_cursors
+    # Paged mirror: there are no cursors — next step's host-planned
+    # positions ARE the rollback — so there is nothing to adopt.
+    if not self._paged:
+      self._cursors = new_cursors
 
   def observe_skip(self, plan):
     # A skipped step (resilience spec_off window) means the mirror cache
