@@ -20,6 +20,12 @@ chaos:
 chaos-serve:
 	python -m pytest tests/test_serving_resilience.py -q
 
+# Router chaos: replica kills mid-decode, replica hangs, flapping health
+# against the multi-replica control plane — bit-exact failover, graceful
+# drain/rejoin, circuit breaker (docs/serving.md "Multi-replica serving").
+chaos-router:
+	python -m pytest tests/test_serving_router.py -q
+
 # Continuous batching vs static-batch generate() under Poisson arrivals
 # (benchmarks/decode_throughput.py -> BENCH_EVIDENCE.json; docs/serving.md).
 serve-bench:
@@ -43,6 +49,13 @@ spec-bench:
 overload-bench:
 	python benchmarks/serving_overload.py
 
+# Replica-kill failover episode: 1 vs 2 replicas under a Poisson trace,
+# then kill one mid-decode — zero lost requests, streams bit-exact vs
+# the fault-free baseline (benchmarks/router_failover.py ->
+# BENCH_EVIDENCE.json; docs/serving.md "Multi-replica serving").
+router-bench:
+	python benchmarks/router_failover.py
+
 # Tiny traced fit() + serving episode on the CPU mesh -> trace_demo.json
 # (schema-validated; load at ui.perfetto.dev; docs/observability.md).
 trace-demo:
@@ -51,4 +64,4 @@ trace-demo:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test bench chaos chaos-serve serve-bench paged-bench spec-bench overload-bench trace-demo clean
+.PHONY: all build test bench chaos chaos-serve chaos-router serve-bench paged-bench spec-bench overload-bench router-bench trace-demo clean

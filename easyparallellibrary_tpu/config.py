@@ -488,6 +488,31 @@ class ServingConfig(_Category):
       # result fetch) exceeds this wall-clock deadline (0 = off).  The
       # step is not interrupted — observability, like the fit() one.
       "resilience.step_timeout_s": 0.0,
+      # --- replicated serving control plane (serving/router.py,
+      # docs/serving.md "Multi-replica serving").  N engine replicas —
+      # each with its own mesh/engine, sharing nothing but the params
+      # source — behind a health-checked Router: bit-exact failover of
+      # queued AND in-flight requests via the prefix-replay path,
+      # graceful drain + warm rejoin, prefix-affinity + least-loaded
+      # dispatch degrading to round-robin on stale signals.
+      "router.replicas": 1,
+      # Expected heartbeat interval: each completed replica step beats;
+      # load signals older than 2x this are considered stale (dispatch
+      # degrades to round-robin).
+      "router.heartbeat_s": 1.0,
+      # Heartbeat age that moves a replica healthy -> suspect (no new
+      # dispatch, existing work continues) and suspect -> down (its
+      # requests fail over to survivors).  suspect_after <= down_after.
+      "router.suspect_after": 3.0,
+      "router.down_after": 10.0,
+      # Graceful drain: a draining replica gets this long to finish its
+      # active requests before the leftovers are migrated to survivors
+      # (0 = migrate immediately).
+      "router.drain_timeout_s": 30.0,
+      # Prefix-affinity dispatch: route requests sharing a prompt prefix
+      # to the replica that served it last (warm KV / prefix-cache
+      # locality), load permitting.  Off = pure least-loaded.
+      "router.affinity": True,
   }
 
   @property
@@ -501,6 +526,10 @@ class ServingConfig(_Category):
   @property
   def resilience(self) -> _SubGroup:
     return _SubGroup(self, "resilience")
+
+  @property
+  def router(self) -> _SubGroup:
+    return _SubGroup(self, "router")
 
 
 class ObservabilityConfig(_Category):
@@ -734,6 +763,23 @@ class Config:
     if res.step_timeout_s < 0:
       raise ValueError(f"serving.resilience.step_timeout_s must be >= 0 "
                        f"(0 = off); got {res.step_timeout_s}")
+    router = self.serving.router
+    if router.replicas < 1:
+      raise ValueError(f"serving.router.replicas must be >= 1; "
+                       f"got {router.replicas}")
+    if router.heartbeat_s <= 0:
+      raise ValueError(f"serving.router.heartbeat_s must be > 0; "
+                       f"got {router.heartbeat_s}")
+    if not 0 < router.suspect_after <= router.down_after:
+      raise ValueError(
+          f"serving.router.suspect_after must be > 0 and <= down_after "
+          f"(a replica cannot go down before it goes suspect); got "
+          f"suspect_after={router.suspect_after}, "
+          f"down_after={router.down_after}")
+    if router.drain_timeout_s < 0:
+      raise ValueError(f"serving.router.drain_timeout_s must be >= 0 "
+                       f"(0 = migrate immediately); got "
+                       f"{router.drain_timeout_s}")
 
   def to_dict(self) -> Dict[str, Dict[str, Any]]:
     return {c._name: getattr(self, c._name).to_dict()

@@ -8,7 +8,12 @@ prints, without leaving the terminal for Perfetto:
 * **request timelines** — per serving request: queue wait, prefill
   time/chunks, decode steps, speculation drafted/accepted, TTFT,
   total latency and finish reason (where did THIS request's latency
-  go).
+  go);
+* with ``--metrics <metrics.jsonl>``, the **fleet rollup** — the last
+  ``serving/fleet/*`` record a multi-replica Router published through
+  the registry (tokens/s summed, merged TTFT/ITL percentiles,
+  shed/failover counters, replica state counts; docs/serving.md
+  "Multi-replica serving").
 
 Reads the Chrome-trace JSON the tracer exports (observability/trace.py)
 — and nothing else; the report is a pure function of the artifact, so
@@ -179,6 +184,67 @@ def _fmt_us(us: Optional[float]) -> str:
   return f"{us / 1e3:.2f}ms" if us >= 1e3 else f"{us:.0f}us"
 
 
+def fleet_rollup(metrics_path: str) -> Optional[Dict[str, Any]]:
+  """The LAST ``serving/fleet/*`` record in a registry-written metrics
+  JSONL (one ``{"step", "time", **namespaced_keys}`` object per line),
+  with the namespace prefix stripped — or None when the file holds no
+  fleet record.  Lenient to trailing partial lines (a live server's
+  sink may be mid-write) — post-mortems read partial logs."""
+  prefix = "serving/fleet/"
+  last: Optional[Dict[str, Any]] = None
+  try:
+    with open(metrics_path) as f:
+      for line in f:
+        try:
+          rec = json.loads(line)
+        except ValueError:
+          continue
+        if not isinstance(rec, dict):
+          continue  # a truncated line can still parse (e.g. a number)
+        fleet = {k[len(prefix):]: v for k, v in rec.items()
+                 if k.startswith(prefix)}
+        if fleet:
+          fleet["step"] = rec.get("step")
+          last = fleet
+  except OSError:
+    return None
+  return last
+
+
+def format_fleet(fleet: Dict[str, Any]) -> str:
+  """Render one fleet rollup as a compact block (keys grouped:
+  throughput / latency / resolution / control plane)."""
+  def g(key, default=0.0):
+    return fleet.get(key, default)
+
+  lines = [
+      f"fleet rollup (step {fleet.get('step', '-')}): "
+      f"{g('replicas'):.0f} replica(s) — "
+      f"{g('replicas_healthy'):.0f} healthy, "
+      f"{g('replicas_suspect'):.0f} suspect, "
+      f"{g('replicas_down'):.0f} down, "
+      f"{g('replicas_draining'):.0f} draining",
+      f"  throughput: {g('tokens_per_s'):.1f} tok/s summed, "
+      f"{g('finished_requests'):.0f} finished, "
+      f"{g('generated_tokens'):.0f} tokens, "
+      f"occupancy {g('slot_occupancy_mean'):.2f}",
+      f"  latency:    ttft p50 {g('ttft_p50_s') * 1e3:.1f}ms "
+      f"p99 {g('ttft_p99_s') * 1e3:.1f}ms, "
+      f"itl p50 {g('itl_p50_s') * 1e3:.2f}ms "
+      f"p99 {g('itl_p99_s') * 1e3:.2f}ms (merged raw samples)",
+      f"  resolution: shed {g('shed'):.0f} (+{g('router_shed'):.0f} at "
+      f"router), deadline {g('deadline_expired'):.0f}, "
+      f"cancelled {g('cancelled'):.0f}, failed {g('failed'):.0f}",
+      f"  control:    failovers {g('failovers'):.0f}, "
+      f"migrated {g('migrated_requests'):.0f}, "
+      f"probes {g('probes'):.0f}, parked {g('parked'):.0f}, "
+      f"requeues {g('requeues'):.0f}, "
+      f"preemptions {g('preemptions'):.0f} "
+      f"(+{g('proactive_preemptions'):.0f} proactive)",
+  ]
+  return "\n".join(lines)
+
+
 def format_report(events: List[Dict[str, Any]]) -> str:
   spans, unmatched = pair_spans(events)
   lines: List[str] = []
@@ -234,8 +300,19 @@ def main(argv: Optional[List[str]] = None) -> int:
       description="Latency-breakdown summary of an exported trace "
                   "(observability/trace.py JSON).")
   parser.add_argument("trace", help="path to the exported trace JSON")
+  parser.add_argument(
+      "--metrics", default=None,
+      help="registry metrics JSONL; prints the last serving/fleet/* "
+           "rollup a multi-replica Router published")
   args = parser.parse_args(argv)
   print(format_report(load_events(args.trace)))
+  if args.metrics is not None:
+    fleet = fleet_rollup(args.metrics)
+    print()
+    if fleet is None:
+      print(f"no serving/fleet/* record in {args.metrics}")
+    else:
+      print(format_fleet(fleet))
   return 0
 
 

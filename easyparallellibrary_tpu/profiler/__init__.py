@@ -5,11 +5,13 @@ from easyparallellibrary_tpu.profiler.memory import (
     MemoryProfiler, device_memory_stats, compiled_memory,
 )
 from easyparallellibrary_tpu.profiler.profiler import StepProfiler
-from easyparallellibrary_tpu.profiler.serving import ServingStats, percentile
+from easyparallellibrary_tpu.profiler.serving import (
+    ServingStats, fleet_summary, percentile,
+)
 
 __all__ = [
     "FlopsProfiler", "compiled_cost", "estimate_mfu", "peak_flops_per_chip",
     "MemoryProfiler", "device_memory_stats", "compiled_memory",
     "StepProfiler",
-    "ServingStats", "percentile",
+    "ServingStats", "fleet_summary", "percentile",
 ]

@@ -121,6 +121,7 @@ class ServingStats:
     self.kv_blocks_used = 0
     self.kv_fragmentation = 0.0
     self.preemptions = 0
+    self.proactive_preemptions = 0
     # Live ITL estimate: EWMA of decode-step wall time (module
     # docstring).  0.0 until the SECOND decoding step — the first
     # decode-step sample can carry one-time XLA compile work (a draft
@@ -133,8 +134,12 @@ class ServingStats:
 
   # ------------------------------------------------------------ lifecycle
 
-  def note_submitted(self, uid: Any):
-    self._req[uid] = _RequestTrace(self._clock())
+  def note_submitted(self, uid: Any, at: Optional[float] = None):
+    """``at`` backdates the submit timestamp (same clock domain) — a
+    MIGRATED request keeps its original submit time on the survivor, so
+    its TTFT sample includes the pre-failover wait instead of hiding
+    exactly the latency failover costs."""
+    self._req[uid] = _RequestTrace(self._clock() if at is None else at)
 
   def note_admitted(self, uid: Any):
     tr = self._req.setdefault(uid, _RequestTrace(self._clock()))
@@ -184,14 +189,17 @@ class ServingStats:
     self.requeues = int(counters["requeues"])
 
   def note_blocks(self, free: int, used: int, fragmentation: float,
-                  preemptions: int):
+                  preemptions: int, proactive_preemptions: int = 0):
     """Paged block-pool gauges, fed per step by the paged engine
-    (last-write-wins: these are levels, not counters — except
-    ``preemptions``, which the scheduler accumulates)."""
+    (last-write-wins: these are levels, not counters — except the two
+    preemption totals, which the scheduler accumulates:
+    pool-exhaustion evictions and eager latency-class admission
+    evictions respectively)."""
     self.kv_blocks_free = int(free)
     self.kv_blocks_used = int(used)
     self.kv_fragmentation = float(fragmentation)
     self.preemptions = int(preemptions)
+    self.proactive_preemptions = int(proactive_preemptions)
 
   def note_degraded(self, level: int):
     self.degraded_transitions += 1
@@ -250,6 +258,16 @@ class ServingStats:
                    / (tr.new_tokens - 1))
     return out
 
+  def ttft_samples(self) -> List[float]:
+    """Raw per-request TTFT samples — the fleet rollup
+    (:func:`fleet_summary`) merges RAW samples across replicas, because
+    percentiles of percentiles are not percentiles."""
+    return self._ttfts()
+
+  def itl_samples(self) -> List[float]:
+    """Raw per-request mean-ITL samples (see :meth:`ttft_samples`)."""
+    return self._itls()
+
   def publish(self, registry, step: int):
     """Publish :meth:`summary` under ``serving/*`` through a
     MetricRegistry (observability/registry.py) — the engine calls this
@@ -289,6 +307,7 @@ class ServingStats:
         "kv_blocks_used": float(self.kv_blocks_used),
         "kv_fragmentation": float(self.kv_fragmentation),
         "preemptions": float(self.preemptions),
+        "proactive_preemptions": float(self.proactive_preemptions),
         # Resilience (all 0.0 on a non-resilient engine; docs/
         # robustness.md "Serving resilience").
         "shed": float(self.shed_requests),
@@ -303,3 +322,75 @@ class ServingStats:
         "watchdog_timeouts": float(self.watchdog_timeouts),
         "itl_ewma_s": float(self.itl_ewma_s),
     }
+
+
+def fleet_summary(replica_stats: List["ServingStats"],
+                  router_counters: Optional[Dict[str, float]] = None
+                  ) -> Dict[str, float]:
+  """Fleet-level rollup over N replicas' :class:`ServingStats` — ONE
+  record for the whole serving deployment (serving/router.py publishes
+  it under the ``serving/fleet/*`` registry namespace; docs/serving.md
+  "Multi-replica serving").
+
+  Merge rules, per metric kind:
+
+  * **rates** (tokens/s) sum — replicas serve concurrently, so fleet
+    throughput is the sum of per-replica throughput, NOT total tokens
+    over summed busy time (which would read as a mean);
+  * **latency percentiles** (TTFT/ITL) re-rank over the replicas' RAW
+    per-request samples — percentiles of per-replica percentiles are
+    not percentiles;
+  * **counters** (tokens, requests, shed, retries, preemptions...) sum;
+  * **occupancy** weights each replica's mean by its step count.
+
+  ``router_counters`` (failovers, migrated requests, per-state replica
+  counts, router-level sheds) merge in verbatim — the router owns
+  those; a request that failed over finished on exactly ONE replica, so
+  summed finish counters stay double-count-free."""
+  stats = list(replica_stats)
+  ttfts: List[float] = []
+  itls: List[float] = []
+  for s in stats:
+    ttfts.extend(s.ttft_samples())
+    itls.extend(s.itl_samples())
+  steps = sum(s.steps for s in stats)
+  occ = (sum(s._occupancy_sum for s in stats) / steps) if steps else 0.0
+  drafted = sum(s.drafted_tokens for s in stats)
+  accepted = sum(s.accepted_tokens for s in stats)
+  out = {
+      "replicas": float(len(stats)),
+      "steps": float(steps),
+      "finished_requests": float(
+          sum(s.finished_requests for s in stats)),
+      "generated_tokens": float(sum(s.generated_tokens for s in stats)),
+      "tokens_per_s": sum(
+          s.generated_tokens / max(s.busy_time_s, 1e-9) for s in stats),
+      "ttft_p50_s": percentile(ttfts, 50),
+      "ttft_p99_s": percentile(ttfts, 99),
+      "itl_mean_s": (sum(itls) / len(itls)) if itls else 0.0,
+      "itl_p50_s": percentile(itls, 50),
+      "itl_p99_s": percentile(itls, 99),
+      "slot_occupancy_mean": occ,
+      "drafted_tokens": float(drafted),
+      "accepted_tokens": float(accepted),
+      "acceptance_rate": (accepted / drafted) if drafted else 0.0,
+      "shed": float(sum(s.shed_requests for s in stats)),
+      "deadline_expired": float(
+          sum(s.finish_reasons.get("deadline", 0) for s in stats)),
+      "cancelled": float(
+          sum(s.finish_reasons.get("cancelled", 0) for s in stats)),
+      "failed": float(
+          sum(s.finish_reasons.get("failed", 0) for s in stats)),
+      "bad_steps": float(sum(s.bad_steps for s in stats)),
+      "step_retries": float(sum(s.step_retries for s in stats)),
+      "requeues": float(sum(s.requeues for s in stats)),
+      "preemptions": float(sum(s.preemptions for s in stats)),
+      "proactive_preemptions": float(
+          sum(s.proactive_preemptions for s in stats)),
+      "degraded": float(sum(s.degraded_transitions for s in stats)),
+      "watchdog_timeouts": float(
+          sum(s.watchdog_timeouts for s in stats)),
+  }
+  if router_counters:
+    out.update({k: float(v) for k, v in router_counters.items()})
+  return out

@@ -8,7 +8,10 @@ batch every iteration (:mod:`engine`), speculative decoding — drafters
 plus batched verification with per-slot accept/rollback riding that
 same step (:mod:`speculative`) — and a resilience layer: admission
 control with overload shedding, per-request deadlines/cancellation,
-and bad-step retry/quarantine (:mod:`resilience`).  See
+and bad-step retry/quarantine (:mod:`resilience`) — plus a replicated
+control plane: N engine replicas (:mod:`replica`) behind a
+health-checked :class:`Router` with bit-exact failover, graceful
+drain/rejoin and prefix-affinity dispatch (:mod:`router`).  See
 docs/serving.md and docs/robustness.md.
 """
 
@@ -19,8 +22,11 @@ from easyparallellibrary_tpu.serving.engine import (
     ContinuousBatchingEngine, filtered_logits, sample_token_slots,
 )
 from easyparallellibrary_tpu.serving.resilience import (
-    DEGRADE_LEVELS, AdmissionController, BadStepPolicy,
+    DEGRADE_LEVELS, HEALTH_STATES, AdmissionController, BadStepPolicy,
+    ReplicaHealth,
 )
+from easyparallellibrary_tpu.serving.replica import EngineReplica
+from easyparallellibrary_tpu.serving.router import Router
 from easyparallellibrary_tpu.serving.kv_cache import (
     NULL_BLOCK, BlockAllocator, SlotAllocator, allocate_kv_cache,
     allocate_paged_kv_cache, blocks_per_slot, cache_bytes, cache_length,
@@ -45,6 +51,7 @@ __all__ = [
     "check_draft_compatible", "check_servable",
     "AdmissionController", "BadStepPolicy", "DEGRADE_LEVELS",
     "FINISH_REASONS", "PRIORITIES",
+    "EngineReplica", "HEALTH_STATES", "ReplicaHealth", "Router",
     "Drafter", "DraftModelDrafter", "NgramDrafter", "ngram_propose",
     "verify_tokens",
 ]

@@ -24,10 +24,10 @@ ROADMAP_DRAFT_DISTILL = (
     "distillation'; docs/serving.md 'Speculative decoding')")
 ROADMAP_PREEMPTION = (
     "priority reorders ADMISSION, and on the paged engine "
-    "(serving.paged.enabled) block-pool exhaustion preempts the "
-    "youngest lowest-priority RUNNING slot (reason 'preempted'); "
-    "proactive latency-class preemption before the pool runs dry is a "
-    "ROADMAP follow-up (item 2)")
+    "(serving.paged.enabled) a RUNNING throughput-class slot is "
+    "preempted (reason 'preempted') both on block-pool exhaustion and "
+    "EAGERLY when a latency-class arrival would otherwise queue "
+    "(serving/proactive_preemptions; docs/robustness.md)")
 
 # Finish-reason glossary (docs/robustness.md "Serving resilience"):
 #   length      — max_new_tokens reached

@@ -681,6 +681,36 @@ class ContinuousBatchingEngine:
     unknown/already-finished uids."""
     return self.scheduler.cancel(uid)
 
+  # ------------------------------------------------- snapshot / migration
+
+  def snapshot_requests(self) -> List[Dict[str, Any]]:
+    """Serializable snapshots of every queued + in-flight request
+    (scheduler.snapshot_requests) — the failover/drain currency of the
+    multi-replica router (serving/router.py): restoring them on another
+    engine sharing the params source resumes each stream bit-exactly
+    via prefix replay."""
+    return self.scheduler.snapshot_requests()
+
+  def restore_request(self, snap: Dict[str, Any],
+                      front: bool = False) -> Any:
+    """Resubmit a snapshotted request (bit-exact resumption; see
+    :meth:`snapshot_requests`).  Bypasses admission control on purpose:
+    a migrated request was already admitted by the fleet once — shedding
+    it here would double-charge it for the overload verdict."""
+    uid = self.scheduler.restore_request(snap, front=front)
+    if self.stats is not None:
+      # Keep the ORIGINAL submit time (same monotonic clock domain) so
+      # the survivor's TTFT sample includes the pre-migration wait.
+      self.stats.note_submitted(uid, at=snap.get("submitted_at"))
+    return uid
+
+  def evacuate(self) -> List[Dict[str, Any]]:
+    """Snapshot and REMOVE every queued + in-flight request (no finish
+    records — they finish elsewhere).  The router's failover and
+    drain-timeout migration path; the engine stays warm (cache, compiled
+    step and watchdog untouched) and can serve again immediately."""
+    return self.scheduler.evacuate()
+
   @property
   def has_work(self) -> bool:
     return self.scheduler.has_work
@@ -1046,7 +1076,8 @@ class ContinuousBatchingEngine:
         self.stats.note_blocks(self.scheduler.kv_blocks_free,
                                self.scheduler.kv_blocks_used,
                                self.scheduler.kv_fragmentation,
-                               self.scheduler.preemptions)
+                               self.scheduler.preemptions,
+                               self.scheduler.proactive_preemptions)
     if self.metrics_writer is not None or self.registry is not None:
       record = {
           "active_slots": plan.active_slots,
@@ -1063,6 +1094,8 @@ class ContinuousBatchingEngine:
         record["kv_blocks_used"] = self.scheduler.kv_blocks_used
         record["kv_fragmentation"] = self.scheduler.kv_fragmentation
         record["preemptions"] = self.scheduler.preemptions
+        record["proactive_preemptions"] = (
+            self.scheduler.proactive_preemptions)
       if self.drafter is not None:
         record["drafted_tokens"] = drafted
         record["accepted_tokens"] = accepted

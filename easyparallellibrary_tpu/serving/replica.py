@@ -1,0 +1,175 @@
+"""One serving replica: an engine plus the host-side plumbing the
+router needs to treat it as a fleet member.
+
+A replica is a :class:`~easyparallellibrary_tpu.serving.engine.
+ContinuousBatchingEngine` with its own scheduler, KV cache, compiled
+fused step, watchdog and :class:`~easyparallellibrary_tpu.profiler.
+serving.ServingStats` — replicas share NOTHING but the params source
+(the same sharded arrays; params are read-only in serving, so N engines
+can hold the same reference).  On top of the engine this class adds:
+
+* **heartbeat material** — every :meth:`step` returns normally or
+  raises; the router converts the former into a health beat carrying
+  the live signals the step already produced on the host (cumulative
+  watchdog-timeout and bad-step counters, the ITL EWMA) and the latter
+  into ``mark_down`` + failover.  The replica itself holds no health
+  state — policy lives in :class:`serving.resilience.ReplicaHealth`,
+  mechanics here.
+* **load signals** — ``queue_depth`` / ``num_active`` / ``load`` for
+  least-loaded dispatch (the same occupancy/queue gauges the engine
+  already publishes through the metric registry).
+* **a per-replica metric namespace** — the engine's ``serving/*``
+  registry records are re-rooted to ``serving/replica<i>/*`` via a thin
+  proxy, so one registry shows every replica side by side plus the
+  router's ``serving/fleet/*`` rollup (docs/observability.md).
+* **migration endpoints** — :meth:`snapshot_requests` /
+  :meth:`restore_request` / :meth:`evacuate` delegate to the engine's
+  bit-exact prefix-replay machinery (scheduler.snapshot_requests).
+
+Thread-hosting note: the router drives replicas synchronously (one
+``step()`` sweep per router step) — deterministic, test-friendly, and
+faithful to the failure modes that matter (a step that raises models a
+dead process: its HOST state is what a control plane could recover from
+a request journal; a step that stalls models a hung device).  Nothing
+here holds state that would prevent moving a replica behind a thread or
+process boundary later — the snapshot currency is already serializable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from easyparallellibrary_tpu.serving.engine import ContinuousBatchingEngine
+from easyparallellibrary_tpu.serving.scheduler import (
+    FinishedRequest, Request)
+
+
+class _ReplicaRegistry:
+  """Registry proxy re-rooting ``serving`` → ``serving/replica<i>``.
+
+  The engine and its ServingStats publish under the ``serving``
+  namespace unconditionally; wrapping the registry (instead of teaching
+  them a prefix parameter) keeps every existing producer untouched while
+  per-replica records land under their own sub-namespace — the schema
+  already allows sub-namespaces (observability/registry.py)."""
+
+  def __init__(self, inner, index: int):
+    self._inner = inner
+    self._prefix = f"serving/replica{index}"
+
+  def publish(self, step: int, metrics, namespace: str = "train"):
+    if namespace == "serving":
+      namespace = self._prefix
+    elif namespace.startswith("serving/"):
+      namespace = self._prefix + namespace[len("serving"):]
+    self._inner.publish(step, metrics, namespace)
+
+  def __getattr__(self, name):
+    return getattr(self._inner, name)
+
+
+class EngineReplica:
+  """One fleet member: engine + stats + migration endpoints.
+
+  ``engine_kwargs`` pass through to :class:`ContinuousBatchingEngine`
+  (num_slots, prefill_chunk, drafter, resilience, paged, ...).  A
+  ``stats`` object is always attached (built here when the caller
+  passes none) — the router's health beats and the fleet rollup read
+  it.  ``registry`` (optional) is wrapped per-replica; pass the SAME
+  registry to every replica and the router.
+  """
+
+  def __init__(self, index: int, model, params, *, mesh=None,
+               registry=None, config=None, stats=None, **engine_kwargs):
+    self.index = index
+    if stats is None and engine_kwargs.get("stats") is None:
+      from easyparallellibrary_tpu.profiler.serving import ServingStats
+      stats = ServingStats()
+    if stats is not None:
+      engine_kwargs["stats"] = stats
+    self.engine = ContinuousBatchingEngine(
+        model, params, mesh=mesh, config=config,
+        registry=(_ReplicaRegistry(registry, index)
+                  if registry is not None else None),
+        **engine_kwargs)
+    self.stats = self.engine.stats
+    self.steps = 0
+
+  # ------------------------------------------------------------- serving
+
+  def submit(self, request: Request) -> bool:
+    return self.engine.submit(request)
+
+  def cancel(self, uid: Any) -> bool:
+    return self.engine.cancel(uid)
+
+  def step(self) -> List[FinishedRequest]:
+    """One engine iteration (cheap when idle).  Raises whatever the
+    engine raises — the router treats an escaping exception as this
+    replica dying mid-step."""
+    fins = self.engine.step()
+    self.steps += 1
+    return fins
+
+  @property
+  def has_work(self) -> bool:
+    return self.engine.has_work
+
+  @property
+  def finished(self) -> Dict[Any, FinishedRequest]:
+    return self.engine.finished
+
+  # -------------------------------------------------------- load signals
+
+  @property
+  def queue_depth(self) -> int:
+    return self.engine.scheduler.queue_depth
+
+  @property
+  def num_active(self) -> int:
+    return self.engine.scheduler.num_active
+
+  @property
+  def num_slots(self) -> int:
+    return self.engine.num_slots
+
+  @property
+  def load(self) -> int:
+    """Requests this replica is responsible for (active + queued) — the
+    least-loaded dispatch key."""
+    return self.num_active + self.queue_depth
+
+  # ------------------------------------------------------ health signals
+
+  @property
+  def watchdog_timeouts(self) -> int:
+    return self.stats.watchdog_timeouts if self.stats is not None else 0
+
+  @property
+  def bad_steps(self) -> int:
+    return self.stats.bad_steps if self.stats is not None else 0
+
+  @property
+  def itl_ewma_s(self) -> float:
+    return self.stats.itl_ewma_s if self.stats is not None else 0.0
+
+  # ---------------------------------------------------------- migration
+
+  def snapshot_requests(self) -> List[Dict[str, Any]]:
+    return self.engine.snapshot_requests()
+
+  def restore_request(self, snap: Dict[str, Any],
+                      front: bool = False) -> Any:
+    return self.engine.restore_request(snap, front=front)
+
+  def evacuate(self) -> List[Dict[str, Any]]:
+    return self.engine.evacuate()
+
+  # ----------------------------------------------------------- lifecycle
+
+  def close(self):
+    self.engine.close()
+
+  def __repr__(self):
+    return (f"EngineReplica({self.index}, active={self.num_active}, "
+            f"queued={self.queue_depth})")

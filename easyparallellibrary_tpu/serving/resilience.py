@@ -37,6 +37,7 @@ fused step.  Knobs: the ``serving.resilience.*`` config group
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional
 
 from easyparallellibrary_tpu.utils.logging import get_logger
@@ -44,6 +45,14 @@ from easyparallellibrary_tpu.utils.logging import get_logger
 # Degradation ladder levels, in escalation order.  The index is the
 # level number the engine/metrics carry.
 DEGRADE_LEVELS = ("normal", "spec_off", "budget_tight", "shed")
+
+# Replica health states (serving/router.py; docs/serving.md
+# "Multi-replica serving").  Only "healthy" receives new dispatch;
+# "suspect" keeps its in-flight work but is skipped by routing;
+# "down" triggers failover of its queued + in-flight requests;
+# "draining" is the admin-initiated rolling-restart state (finish or
+# migrate within drain_timeout_s, then rejoin warm).
+HEALTH_STATES = ("healthy", "suspect", "down", "draining")
 
 
 class AdmissionController:
@@ -255,3 +264,193 @@ class BadStepPolicy:
             "step_retries": self.step_retries,
             "requeues": self.requeues,
             "failed_requests": self.failures}
+
+
+class ReplicaHealth:
+  """Health state machine + circuit breaker for ONE serving replica.
+
+  The router feeds two signal kinds and reads one state back:
+
+  * :meth:`beat` — the replica's step loop calls it after every
+    COMPLETED engine step, carrying the live signals the step already
+    has on the host (the StepWatchdog's timeout count, the BadStepPolicy
+    streak counters, the measured ITL EWMA).  A beat is the heartbeat;
+    its arguments decide whether it is a *clean* one.
+  * :meth:`observe` — the router polls it each scheduling round.
+    Heartbeat age drives the passive half of the machine: a replica
+    silent past ``suspect_after`` seconds is ``suspect`` (no new
+    dispatch; its in-flight work keeps running), past ``down_after`` it
+    is ``down`` (failover).  A beat carrying a watchdog timeout or an
+    over-SLO ITL also marks the replica suspect — it answered, but too
+    slowly to trust with new latency-sensitive work.
+  * :meth:`mark_down` — the active half: the router calls it when a
+    replica's step RAISES (the thread/process died mid-decode).
+
+  Recovery goes through the **circuit breaker**: every trip to ``down``
+  counts, and :meth:`can_probe` only opens after a cooldown that
+  doubles per trip (capped), so a flapping replica — one that dies,
+  rejoins clean, and dies again — is held out exponentially longer each
+  round instead of bouncing traffic.  :meth:`rejoin` closes the breaker
+  half-open: the replica is routable again, but its next ``mark_down``
+  doubles the hold-out rather than restarting the ladder.
+
+  ``drain()`` / ``rejoin()`` implement the rolling-restart path: a
+  draining replica is unroutable but healthy; rejoin resumes admission
+  warm (the engine and its compiled step were never torn down).
+
+  Pure host policy — injectable ``clock``, no jax, unit-testable with a
+  fake clock like the ladder above.  ``on_transition(old, new, reason)``
+  fires on every state change (the router hooks tracer instants in).
+  """
+
+  def __init__(self, suspect_after: float = 3.0, down_after: float = 10.0,
+               heartbeat_s: float = 1.0, itl_slo_s: float = 0.0,
+               clock: Callable[[], float] = time.monotonic,
+               on_transition: Optional[Callable] = None):
+    if not 0 < suspect_after <= down_after:
+      raise ValueError(
+          f"need 0 < suspect_after <= down_after; got "
+          f"suspect_after={suspect_after}, down_after={down_after}")
+    if heartbeat_s <= 0:
+      raise ValueError(f"heartbeat_s must be > 0: {heartbeat_s}")
+    self.suspect_after = suspect_after
+    self.down_after = down_after
+    self.heartbeat_s = heartbeat_s
+    self.itl_slo_s = itl_slo_s
+    self.clock = clock
+    self.on_transition = on_transition
+    self.state = "healthy"
+    self.last_beat = clock()
+    self.last_clean_beat = self.last_beat
+    self.trips = 0              # healthy->down round trips (breaker)
+    self.transitions = 0
+    self.down_reason = ""
+    self._down_since = 0.0
+    # Cumulative-counter watermarks: beats carry the stats objects'
+    # running totals, and only an INCREASE is a fresh incident — an old
+    # timeout must not keep every later beat dirty forever.
+    self._last_bad_steps = 0
+    self._last_watchdog = 0
+
+  # --------------------------------------------------------------- signals
+
+  def _set_state(self, new: str, reason: str = ""):
+    if new == self.state:
+      return
+    old, self.state = self.state, new
+    self.transitions += 1
+    if new == "down":
+      self.trips += 1
+      self._down_since = self.clock()
+      self.down_reason = reason
+    get_logger().warning(
+        "replica health: %s -> %s%s", old, new,
+        f" ({reason})" if reason else "")
+    if self.on_transition is not None:
+      self.on_transition(old, new, reason)
+
+  def beat(self, watchdog_timeouts: int = 0, bad_steps: int = 0,
+           itl_s: float = 0.0) -> None:
+    """One completed engine step.  ``watchdog_timeouts`` / ``bad_steps``
+    are CUMULATIVE counters (the stats objects already hold them);
+    deltas are computed here.  A down/draining replica's beats are
+    recorded (staleness clears) but never auto-promote — recovery from
+    ``down`` goes through :meth:`rejoin`, and ``draining`` is admin
+    state."""
+    now = self.clock()
+    self.last_beat = now
+    hung = watchdog_timeouts > self._last_watchdog
+    bad = bad_steps > self._last_bad_steps
+    self._last_watchdog = max(self._last_watchdog, watchdog_timeouts)
+    self._last_bad_steps = max(self._last_bad_steps, bad_steps)
+    slow = self.itl_slo_s > 0 and itl_s > self.itl_slo_s
+    if hung or bad or slow:
+      if self.state == "healthy":
+        self._set_state(
+            "suspect",
+            "watchdog timeout" if hung else
+            ("bad device step" if bad else "ITL over SLO"))
+      return
+    self.last_clean_beat = now
+    if self.state == "suspect":
+      self._set_state("healthy", "clean beat")
+
+  def touch(self, now: Optional[float] = None) -> None:
+    """Reset the heartbeat clock WITHOUT a step.  The router calls this
+    for an IDLE replica at dispatch time: an idle replica's loop is not
+    running, so absence of beats is not evidence of death — only a
+    replica that owes work can go stale.  (Without this, a healthy
+    fleet quiet for ``suspect_after`` seconds would shed its first
+    request after every lull.)  No state transitions: a suspect set by
+    a dirty beat still needs a CLEAN beat to clear."""
+    if self.state in ("down", "draining"):
+      return
+    self.last_beat = self.clock() if now is None else now
+
+  def observe(self, now: Optional[float] = None) -> str:
+    """Heartbeat-staleness check; returns the (possibly new) state.
+    Draining and down are sticky — staleness never demotes an admin
+    state, and only :meth:`rejoin` recovers a down replica."""
+    now = self.clock() if now is None else now
+    if self.state in ("down", "draining"):
+      return self.state
+    age = now - self.last_beat
+    if age >= self.down_after:
+      self._set_state("down", f"no heartbeat for {age:.2f}s")
+    elif age >= self.suspect_after and self.state == "healthy":
+      self._set_state("suspect", f"heartbeat stale ({age:.2f}s)")
+    return self.state
+
+  def mark_down(self, reason: str = "step raised") -> None:
+    """Active failure report (the replica's step raised / its host died).
+    Trips the breaker immediately."""
+    self._set_state("down", reason)
+
+  # ------------------------------------------------------------- lifecycle
+
+  def drain(self) -> None:
+    """Admin drain: unroutable, but not a failure — no breaker trip."""
+    if self.state != "down":
+      self._set_state("draining", "drain requested")
+
+  def cooldown_s(self) -> float:
+    """Current breaker hold-out: ``down_after`` doubled per trip, capped
+    at 2^6 — a flapping replica waits exponentially longer each round."""
+    return self.down_after * (2 ** min(max(self.trips - 1, 0), 6))
+
+  def can_probe(self, now: Optional[float] = None) -> bool:
+    """True once a down replica's breaker cooldown has elapsed — the
+    router may then :meth:`rejoin` it as a half-open probe."""
+    if self.state != "down":
+      return False
+    now = self.clock() if now is None else now
+    return now - self._down_since >= self.cooldown_s()
+
+  def rejoin(self, force: bool = False) -> bool:
+    """Return the replica to service (rolling-restart rejoin, or a
+    breaker probe).  A down replica rejoins only once :meth:`can_probe`
+    allows it (``force=True`` overrides — the operator knows best);
+    returns False when the breaker refuses.  The trip count is KEPT —
+    a relapse doubles the next hold-out (that is the breaker's whole
+    point); it decays only via :meth:`note_stable`."""
+    if self.state == "down" and not (force or self.can_probe()):
+      return False
+    self.last_beat = self.clock()   # fresh grace period, not instant-stale
+    self._set_state("healthy", "rejoin")
+    return True
+
+  def note_stable(self) -> None:
+    """Forgive one breaker trip (the router calls this after a rejoined
+    replica survives a full cooldown window without incident, so an
+    ancient flap does not tax a now-healthy replica forever)."""
+    self.trips = max(0, self.trips - 1)
+
+  @property
+  def routable(self) -> bool:
+    return self.state == "healthy"
+
+  def signals_stale(self, now: Optional[float] = None) -> bool:
+    """Load signals older than two heartbeats cannot be trusted for
+    least-loaded ranking — dispatch degrades to round-robin."""
+    now = self.clock() if now is None else now
+    return now - self.last_beat > 2.0 * self.heartbeat_s

@@ -1,0 +1,571 @@
+"""Replicated serving control plane: a health-checked router over N
+engine replicas with bit-exact failover.
+
+One engine process is a single point of failure — a hung step, a NaN'd
+replica or a rolling restart kills every in-flight request it holds.
+This module turns the single-engine serving stack into a fleet, in the
+Whale/EPL shape the rest of the repo follows: a THIN coordination layer
+over unchanged per-device programs.  The engines don't know the router
+exists; the router speaks only the host-side currencies the serving
+stack already defined — :class:`Request` snapshots (prefix replay),
+:class:`ServingStats` signals, registry namespaces.
+
+* **Health tracking** — per-replica
+  :class:`~serving.resilience.ReplicaHealth`: heartbeats from each
+  completed replica step (carrying the StepWatchdog timeout count, the
+  BadStepPolicy counters and the measured ITL EWMA the engine already
+  maintains), a healthy → suspect → down state machine, and a circuit
+  breaker whose hold-out doubles per trip so a flapping replica is
+  parked exponentially longer each round.
+* **Bit-exact failover** — when a replica goes down (its step raised,
+  or its heartbeat aged out), its queued AND in-flight requests are
+  snapshotted (:meth:`FCFSScheduler.snapshot_requests`: prompt +
+  committed prefix + lifecycle counters; PRNG state is implicit — the
+  stream key derives from seed/uid and folds by committed token index)
+  and resubmitted to survivors via the prefix-replay path.  A non-shed
+  request therefore finishes with the EXACT greedy stream the
+  single-engine oracle produces, no matter which replica dies when —
+  and since replay is just a chunked prefill, the survivor's fused step
+  never sees a new shape (no failover-induced recompiles).
+* **Graceful drain + rejoin** — :meth:`drain` stops routing to a
+  replica and gives its active requests ``drain_timeout_s`` to finish;
+  leftovers migrate to survivors; :meth:`rejoin` resumes admission with
+  the engine still warm (compiled step and cache untouched) — the
+  rolling-restart primitive.
+* **Dispatch** — prefix-affinity (requests sharing a prompt prefix go
+  back to the replica that served it last — warm KV/prefix-cache
+  locality) + least-loaded (occupancy/queue gauges), degrading to
+  round-robin when a replica's load signals are stale.
+
+Accounting invariants (tests/test_serving_router.py): every submitted
+request resolves EXACTLY once in :attr:`Router.finished` — shed at the
+router (no routable replica), shed by a replica's admission control, or
+finished on exactly one replica (failover moves a request, it never
+forks it) — and the fleet rollup (``serving/fleet/*``,
+:func:`profiler.serving.fleet_summary`) merges per-replica stats
+without double counting.
+
+Everything is driven synchronously: one :meth:`step` sweeps every live
+replica (an idle replica's step is just a heartbeat).  See
+docs/serving.md "Multi-replica serving"; ``make chaos-router`` is the
+acceptance harness.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.observability import trace as trace_lib
+from easyparallellibrary_tpu.profiler.serving import fleet_summary
+from easyparallellibrary_tpu.serving.replica import EngineReplica
+from easyparallellibrary_tpu.serving.resilience import ReplicaHealth
+from easyparallellibrary_tpu.serving.scheduler import (
+    FinishedRequest, Request)
+from easyparallellibrary_tpu.utils.logging import get_logger
+
+# Prompt tokens hashed for prefix-affinity routing: long enough to
+# separate system prompts / few-shot templates, short enough that two
+# requests sharing a template hash together even when their user
+# payloads diverge.
+AFFINITY_PREFIX_TOKENS = 16
+# Bounded prefix->replica map (LRU): affinity is a locality hint, not
+# state — evicting an entry only costs a cold route.
+AFFINITY_CAPACITY = 4096
+
+
+class Router:
+  """Health-checked dispatch over N engine replicas (module docstring).
+
+  Typical drive::
+
+      router = Router(model, params, num_replicas=2, mesh=mesh)
+      router.submit(Request(uid="a", prompt=ids, max_new_tokens=64))
+      outputs = router.run()       # {uid: prompt+generated}
+      router.finished["a"].finish_reason
+      router.drain(0); router.run()           # rolling restart:
+      router.rejoin(0)                        # ...replica 0 warm again
+
+  Every knob defaults from ``serving.router.*``.  ``replicas`` injects
+  prebuilt (or duck-typed fake) replicas for tests; otherwise
+  ``num_replicas`` engines are built here, sharing ``params`` and
+  ``engine_kwargs``.  ``clock`` is injectable for deterministic
+  health/drain tests (production leaves it at ``time.monotonic``).
+  """
+
+  def __init__(self, model=None, params=None, *, num_replicas=None,
+               mesh=None, registry=None, config=None,
+               clock=time.monotonic, replicas=None, **engine_kwargs):
+    root_config = config if config is not None else Env.get().config
+    rconf = root_config.serving.router
+    self._drain_timeout_s = rconf.drain_timeout_s
+    self._affinity_enabled = rconf.affinity
+    self.clock = clock
+    if replicas is not None:
+      self.replicas: List[EngineReplica] = list(replicas)
+    else:
+      n = num_replicas if num_replicas is not None else rconf.replicas
+      if n < 1:
+        raise ValueError(f"num_replicas must be >= 1: {n}")
+      self.replicas = [
+          EngineReplica(i, model, params, mesh=mesh, registry=registry,
+                        config=root_config, **engine_kwargs)
+          for i in range(n)]
+    itl_slo = root_config.serving.resilience.itl_slo_s
+    self.health: List[ReplicaHealth] = [
+        ReplicaHealth(
+            suspect_after=rconf.suspect_after,
+            down_after=rconf.down_after,
+            heartbeat_s=rconf.heartbeat_s, itl_slo_s=itl_slo,
+            clock=clock,
+            on_transition=self._make_health_hook(i))
+        for i in range(len(self.replicas))]
+    self.registry = registry
+    # Fleet-wide resolution record: uid -> FinishedRequest, exactly one
+    # entry per resolved request regardless of which replica (or the
+    # router itself) resolved it.
+    self.finished: Dict[Any, FinishedRequest] = {}
+    # uid -> replica index currently responsible (introspection +
+    # cancel routing); entries die with their request.
+    self.placement: Dict[Any, int] = {}
+    # Requests with NOWHERE to run (every replica down): parked
+    # snapshots, flushed the moment a replica is routable again — a
+    # total outage delays requests, it must not lose them.
+    self._parked: List[Dict[str, Any]] = []
+    self._affinity: "OrderedDict[int, int]" = OrderedDict()
+    self._rr = 0                     # round-robin cursor
+    self._drain_deadline: Dict[int, float] = {}
+    self._rejoined_at: Dict[int, float] = {}
+    self.steps = 0
+    self.failovers = 0               # replica-down events that migrated
+    self.migrated_requests = 0       # snapshots moved (failover + drain)
+    self.router_shed = 0             # shed here: no routable replica
+    self.probes = 0                  # breaker half-open rejoins
+    get_logger().info(
+        "serving router: %d replica(s), suspect/down after %.1fs/%.1fs, "
+        "drain timeout %.1fs, affinity %s", len(self.replicas),
+        rconf.suspect_after, rconf.down_after, rconf.drain_timeout_s,
+        "on" if self._affinity_enabled else "off")
+
+  # ------------------------------------------------------------- health
+
+  def _make_health_hook(self, index: int):
+    def hook(old: str, new: str, reason: str):
+      tracer = trace_lib.get_tracer()
+      if tracer.enabled:
+        tracer.instant(
+            "serving/replica_health", cat="serving", track="serving",
+            args={"replica": index, "from": old, "to": new,
+                  "reason": reason})
+    return hook
+
+  def state(self, index: int) -> str:
+    return self.health[index].state
+
+  def states(self) -> List[str]:
+    return [h.state for h in self.health]
+
+  def _routable(self) -> List[int]:
+    return [i for i, h in enumerate(self.health) if h.routable]
+
+  # ----------------------------------------------------------- dispatch
+
+  @staticmethod
+  def _prefix_hash(prompt: np.ndarray) -> int:
+    return zlib.crc32(
+        np.ascontiguousarray(
+            prompt[:AFFINITY_PREFIX_TOKENS], dtype=np.int32).tobytes())
+
+  def _remember_affinity(self, key: int, index: int) -> None:
+    self._affinity.pop(key, None)
+    self._affinity[key] = index
+    while len(self._affinity) > AFFINITY_CAPACITY:
+      self._affinity.popitem(last=False)
+
+  def _choose(self, prompt: np.ndarray) -> tuple:
+    """Pick a replica for one request: ``(index, reason)`` with reason
+    in {"only", "affinity", "least_loaded", "round_robin"}, or
+    ``(None, "no_replica")`` when nothing is routable."""
+    now = self.clock()
+    for i, h in enumerate(self.health):
+      if self.replicas[i].has_work:
+        # Only a replica that OWES work can go stale; an idle one's
+        # loop isn't running, and absence of beats proves nothing.
+        h.observe(now)
+      else:
+        h.touch(now)
+    self._reap(now)
+    routable = self._routable()
+    if not routable:
+      return None, "no_replica"
+    if len(routable) == 1:
+      return routable[0], "only"
+    if any(self.health[i].signals_stale(now) for i in routable):
+      # Load numbers of unknown age rank nothing: fall back to fair
+      # rotation until fresh beats return.
+      self._rr = (self._rr + 1) % len(routable)
+      return routable[self._rr], "round_robin"
+    if self._affinity_enabled:
+      aff = self._affinity.get(self._prefix_hash(prompt))
+      if (aff is not None and aff in routable
+          and self.replicas[aff].load < self.replicas[aff].num_slots):
+        # Warm prefix AND spare capacity: locality wins.  A saturated
+        # affinity target falls through to least-loaded — affinity is a
+        # tiebreak, never a queueing reason.
+        return aff, "affinity"
+    idx = min(routable, key=lambda i: (self.replicas[i].load, i))
+    return idx, "least_loaded"
+
+  def submit(self, request: Request) -> bool:
+    """Route and enqueue one request; False when it was shed — by the
+    router (no routable replica) or by the chosen replica's admission
+    control.  Either way the shed record lands in :attr:`finished` with
+    reason ``"shed"``, exactly once."""
+    prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+    idx, reason = self._choose(prompt)
+    tracer = trace_lib.get_tracer()
+    if idx is None:
+      self.router_shed += 1
+      self.finished[request.uid] = FinishedRequest(
+          uid=request.uid, tokens=prompt, new_tokens=0,
+          finish_reason="shed")
+      if tracer.enabled:
+        tracer.instant(
+            "serving/route", cat="serving", track="serving/requests",
+            args={"uid": str(request.uid), "replica": -1,
+                  "reason": "no_replica"})
+      get_logger().warning(
+          "router shedding request %r: no routable replica (states %s)",
+          request.uid, self.states())
+      return False
+    if tracer.enabled:
+      tracer.instant(
+          "serving/route", cat="serving", track="serving/requests",
+          args={"uid": str(request.uid), "replica": idx,
+                "reason": reason})
+    accepted = self.replicas[idx].submit(request)
+    if accepted:
+      self.placement[request.uid] = idx
+      if self._affinity_enabled:
+        self._remember_affinity(self._prefix_hash(prompt), idx)
+    else:
+      # The replica's admission control shed it and recorded the
+      # resolution in ITS finished map; mirror fleet-side so callers
+      # never chase per-replica maps (the replica counted the shed —
+      # don't count it again here).
+      fin = self.replicas[idx].finished.get(request.uid)
+      if fin is not None:
+        self.finished[request.uid] = fin
+    return accepted
+
+  def cancel(self, uid: Any) -> bool:
+    """Cancel ``uid`` wherever it lives — on its replica, or in the
+    parked backlog (a parked request must not silently resurrect on the
+    next rejoin after the client abandoned it)."""
+    for k, snap in enumerate(self._parked):
+      if snap["request"]["uid"] == uid:
+        del self._parked[k]
+        generated = np.asarray(snap.get("generated", ()), np.int32)
+        fin = FinishedRequest(
+            uid=uid,
+            tokens=np.concatenate([
+                np.asarray(snap["request"]["prompt"], np.int32),
+                generated]),
+            new_tokens=int(generated.size), finish_reason="cancelled")
+        self._note_finished(-1, fin)
+        return True
+    idx = self.placement.get(uid)
+    if idx is not None:
+      return self.replicas[idx].cancel(uid)
+    for rep in self.replicas:
+      if rep.cancel(uid):
+        return True
+    return False
+
+  # --------------------------------------------------------------- step
+
+  def _note_finished(self, index: int, fin: FinishedRequest) -> None:
+    self.finished[fin.uid] = fin
+    self.placement.pop(fin.uid, None)
+
+  def step(self) -> List[FinishedRequest]:
+    """One fleet sweep: migrate expired drains, step every live replica
+    (collecting retirements and feeding health beats), fail over any
+    replica whose step raised or whose heartbeat aged out, and probe
+    down replicas whose breaker cooldown elapsed.  Returns this sweep's
+    retirements fleet-wide."""
+    now = self.clock()
+    out: List[FinishedRequest] = []
+    self._check_drains(now)
+    self._flush_parked()
+    for i, rep in enumerate(self.replicas):
+      h = self.health[i]
+      if h.state == "down":
+        if h.can_probe(now):
+          self._probe(i)
+        continue
+      try:
+        fins = rep.step()
+      except Exception as e:  # noqa: BLE001 — ANY escaping error = dead
+        get_logger().error(
+            "replica %d died mid-step (%s: %s); failing over",
+            i, type(e).__name__, e)
+        h.mark_down(f"step raised {type(e).__name__}")
+        self._failover(i)
+        continue
+      for fin in fins:
+        self._note_finished(i, fin)
+        out.append(fin)
+      h.beat(watchdog_timeouts=rep.watchdog_timeouts,
+             bad_steps=rep.bad_steps, itl_s=rep.itl_ewma_s)
+      if h.state == "healthy" and h.trips:
+        # Breaker forgiveness: a rejoined replica that survives a full
+        # cooldown window clean sheds one trip.
+        since = self._rejoined_at.get(i, now)
+        if now - since >= h.cooldown_s():
+          h.note_stable()
+          self._rejoined_at[i] = now
+    # A replica that reached "down" without raising (heartbeat aged out
+    # at dispatch time between sweeps) is dead weight holding requests —
+    # fail it over now.  Replicas that just stepped beat above, so their
+    # age is zero and this is a no-op for them.
+    self._reap(now)
+    self.steps += 1
+    return out
+
+  def _reap(self, now: float) -> None:
+    """Fail over any down replica still holding requests.  Idempotent —
+    a replica already evacuated (its step raised) yields no snapshots
+    and is skipped; this catches the passive path, where staleness
+    marked it down without an exception ever unwinding."""
+    for i, h in enumerate(self.health):
+      if h.state == "down" and self.replicas[i].has_work:
+        self._failover(i)
+
+  def run(self, max_steps: Optional[int] = None
+          ) -> Dict[Any, np.ndarray]:
+    """Drive until the fleet drains (or ``max_steps``); returns
+    ``{uid: prompt+generated}`` for requests finished during the call.
+    Publishes the fleet rollup at the end when a registry is
+    attached."""
+    out: Dict[Any, np.ndarray] = {}
+    steps = 0
+    while self.has_work and (max_steps is None or steps < max_steps):
+      for fin in self.step():
+        out[fin.uid] = fin.tokens
+      steps += 1
+      if (self._parked and not self._survivors(-1)
+          and not any(rep.has_work
+                      for i, rep in enumerate(self.replicas)
+                      if self.health[i].state != "down")):
+        # The parked backlog cannot move (no healthy or suspect target)
+        # and no live replica has work of its own to make progress on —
+        # return instead of spinning; the backlog is preserved and a
+        # later run()/step() resumes it after a breaker probe or an
+        # operator rejoin().
+        get_logger().warning(
+            "router.run(): %d request(s) parked with no routable "
+            "replica (states %s); returning — rejoin a replica to "
+            "resume", len(self._parked), self.states())
+        break
+    if self.registry is not None:
+      self.publish(self.registry, self.steps)
+    return out
+
+  @property
+  def has_work(self) -> bool:
+    if self._parked:
+      return True
+    return any(
+        rep.has_work for i, rep in enumerate(self.replicas)
+        if self.health[i].state != "down")
+
+  # ----------------------------------------------------------- failover
+
+  def _survivors(self, exclude: int) -> List[int]:
+    """Failover targets: healthy first; a draining replica is never a
+    target (it is trying to empty), a suspect one only as last resort
+    (it is alive, just slow — better slow than parked)."""
+    healthy = [i for i in self._routable() if i != exclude]
+    if healthy:
+      return healthy
+    return [i for i, h in enumerate(self.health)
+            if h.state == "suspect" and i != exclude]
+
+  def _place_snapshots(self, snaps: List[Dict[str, Any]],
+                       targets: List[int]) -> int:
+    """Distribute snapshots over ``targets`` (least-loaded each time,
+    re-ranked as restores land).  Restores go to the queue FRONT in
+    reverse snapshot order, so the dead replica's service order is
+    preserved on each target.  Returns how many were placed."""
+    placed = 0
+    for snap in reversed(snaps):
+      idx = min(targets, key=lambda i: (self.replicas[i].load, i))
+      uid = self.replicas[idx].restore_request(snap, front=True)
+      self.placement[uid] = idx
+      placed += 1
+    return placed
+
+  def _failover(self, index: int) -> None:
+    """Move a down replica's queued + in-flight requests to survivors
+    (module docstring: prefix replay makes this bit-exact).  With no
+    survivor the snapshots park and flush on the next rejoin — an
+    outage delays, it never loses."""
+    snaps = self.replicas[index].evacuate()
+    for snap in snaps:
+      self.placement.pop(snap["request"]["uid"], None)
+    if not snaps:
+      return
+    self.failovers += 1
+    self.migrated_requests += len(snaps)
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.instant(
+          "serving/failover", cat="serving", track="serving",
+          args={"replica": index, "requests": len(snaps),
+                "reason": self.health[index].down_reason})
+    targets = self._survivors(index)
+    if not targets:
+      get_logger().warning(
+          "failover of replica %d found NO survivor: parking %d "
+          "request(s) until a replica rejoins", index, len(snaps))
+      self._parked.extend(snaps)
+      return
+    self._place_snapshots(snaps, targets)
+    get_logger().warning(
+        "replica %d failed over: %d request(s) resumed on replica(s) %s "
+        "via prefix replay", index, len(snaps), targets)
+
+  def _flush_parked(self) -> None:
+    if not self._parked:
+      return
+    # Same target preference as failover: healthy, else suspect as a
+    # last resort — a parked backlog waiting for a perfect replica is a
+    # parked backlog not being served.
+    targets = self._survivors(-1)
+    if not targets:
+      return
+    snaps, self._parked = self._parked, []
+    self._place_snapshots(snaps, targets)
+    get_logger().info("flushed %d parked request(s) onto replica(s) %s",
+                      len(snaps), targets)
+
+  def _probe(self, index: int) -> None:
+    """Half-open breaker probe: the cooldown elapsed, let the replica
+    serve again; a relapse re-trips with a doubled hold-out."""
+    if self.health[index].rejoin():
+      self.probes += 1
+      self._rejoined_at[index] = self.clock()
+      get_logger().info(
+          "probing replica %d back into service (trip %d, next "
+          "hold-out %.1fs)", index, self.health[index].trips,
+          self.health[index].cooldown_s())
+
+  # ------------------------------------------------------ drain / rejoin
+
+  def drain(self, index: int,
+            timeout_s: Optional[float] = None) -> None:
+    """Graceful drain (rolling restart, step 1): stop routing to
+    ``index``; its active requests get ``timeout_s`` (default
+    ``serving.router.drain_timeout_s``) of fleet steps to finish, then
+    the leftovers migrate to survivors.  The replica stays unroutable
+    (state ``draining``) until :meth:`rejoin`."""
+    self.health[index].drain()
+    timeout = self._drain_timeout_s if timeout_s is None else timeout_s
+    self._drain_deadline[index] = self.clock() + timeout
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.instant(
+          "serving/drain", cat="serving", track="serving",
+          args={"replica": index, "timeout_s": float(timeout)})
+
+  def _check_drains(self, now: float) -> None:
+    for index in list(self._drain_deadline):
+      rep = self.replicas[index]
+      if not rep.has_work:
+        del self._drain_deadline[index]
+        continue
+      if now < self._drain_deadline[index]:
+        continue
+      del self._drain_deadline[index]
+      snaps = rep.evacuate()
+      if not snaps:
+        continue
+      for snap in snaps:
+        # _place_snapshots re-points placed uids; parked ones must not
+        # keep a stale entry naming the evacuated replica.
+        self.placement.pop(snap["request"]["uid"], None)
+      self.migrated_requests += len(snaps)
+      targets = self._survivors(index)
+      tracer = trace_lib.get_tracer()
+      if tracer.enabled:
+        tracer.instant(
+            "serving/drain_migrate", cat="serving", track="serving",
+            args={"replica": index, "requests": len(snaps)})
+      if targets:
+        self._place_snapshots(snaps, targets)
+        get_logger().info(
+            "drain timeout on replica %d: migrated %d request(s) to %s",
+            index, len(snaps), targets)
+      else:
+        self._parked.extend(snaps)
+
+  def rejoin(self, index: int, force: bool = False) -> bool:
+    """Return a drained (or down) replica to service, warm — its engine,
+    cache and compiled step were never torn down.  For a down replica
+    the circuit breaker must agree (``force=True`` overrides)."""
+    ok = self.health[index].rejoin(force=force)
+    if ok:
+      self._drain_deadline.pop(index, None)
+      self._rejoined_at[index] = self.clock()
+      self._flush_parked()
+    return ok
+
+  # -------------------------------------------------------- observability
+
+  def router_counters(self) -> Dict[str, float]:
+    states = self.states()
+    return {
+        "failovers": float(self.failovers),
+        "migrated_requests": float(self.migrated_requests),
+        "router_shed": float(self.router_shed),
+        "probes": float(self.probes),
+        "parked": float(len(self._parked)),
+        "replicas_healthy": float(states.count("healthy")),
+        "replicas_suspect": float(states.count("suspect")),
+        "replicas_down": float(states.count("down")),
+        "replicas_draining": float(states.count("draining")),
+    }
+
+  def fleet_summary(self) -> Dict[str, float]:
+    """One fleet-wide record (profiler.serving.fleet_summary): summed
+    rates/counters, percentiles re-ranked over raw per-replica samples,
+    plus the router's own counters.  Total fleet sheds =
+    ``shed`` (replica admission control) + ``router_shed`` (nothing
+    routable)."""
+    return fleet_summary([rep.stats for rep in self.replicas
+                          if rep.stats is not None],
+                         self.router_counters())
+
+  def publish(self, registry, step: int) -> None:
+    """Publish the rollup under ``serving/fleet/*`` (every replica's own
+    records live under ``serving/replica<i>/*`` beside it)."""
+    registry.publish(step, self.fleet_summary(), "serving/fleet")
+
+  # ----------------------------------------------------------- lifecycle
+
+  def close(self):
+    for rep in self.replicas:
+      rep.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+    return False

@@ -59,6 +59,18 @@ def _slot_track(slot: int) -> str:
   return f"serving/slot {slot}"
 
 
+def _request_key(req: "Request") -> np.ndarray:
+  """The request's private PRNG stream key.  Deterministic in
+  ``seed``/``uid`` and stable across processes (crc32, not Python's
+  per-process-salted hash()), so a request migrated to another replica
+  — or a restarted server — reproduces the identical sample stream."""
+  if req.seed is not None:
+    seed = req.seed
+  else:
+    seed = zlib.crc32(str(req.uid).encode())
+  return np.asarray(jax.random.PRNGKey(seed))
+
+
 @dataclasses.dataclass
 class Request:
   """One generation request.
@@ -96,6 +108,37 @@ class Request:
   deadline_s: float = 0.0
   ttft_budget_s: float = 0.0
   priority: str = "throughput"
+
+  def snapshot(self) -> Dict[str, Any]:
+    """JSON-serializable snapshot of the request spec (the immutable
+    half of cross-replica migration; the scheduler adds the mutable
+    half — committed prefix + lifecycle counters — in
+    :meth:`FCFSScheduler.snapshot_requests`).  The PRNG state needs no
+    field of its own: the stream key derives deterministically from
+    ``seed``/``uid`` (:func:`_request_key`) and is folded by committed
+    token index, so prompt + generated prefix IS the full sampler
+    state."""
+    return {
+        "uid": self.uid,
+        "prompt": [int(t) for t in np.asarray(self.prompt).reshape(-1)],
+        "max_new_tokens": int(self.max_new_tokens),
+        "temperature": float(self.temperature),
+        "top_k": int(self.top_k),
+        "top_p": float(self.top_p),
+        "stop_token": int(self.stop_token),
+        "seed": None if self.seed is None else int(self.seed),
+        "speculative": self.speculative,
+        "deadline_s": float(self.deadline_s),
+        "ttft_budget_s": float(self.ttft_budget_s),
+        "priority": self.priority,
+    }
+
+  @classmethod
+  def restore(cls, snap: Dict[str, Any]) -> "Request":
+    """Inverse of :meth:`snapshot` (tolerates a JSON round trip)."""
+    snap = dict(snap)
+    snap["prompt"] = np.asarray(snap["prompt"], np.int32)
+    return cls(**snap)
 
 
 @dataclasses.dataclass
@@ -192,14 +235,7 @@ class _SlotState:
           [req.prompt, np.asarray(self.generated, np.int32)])
     else:
       self.generated = []
-      if req.seed is not None:
-        seed = req.seed
-      else:
-        # Stable across processes (Python's hash() is salted per
-        # process, which would make a restarted server sample different
-        # streams for the same uid).
-        seed = zlib.crc32(str(req.uid).encode())
-      self.key = np.asarray(jax.random.PRNGKey(seed))
+      self.key = _request_key(req)
       self.first_token_at: Optional[float] = None
       self.first_token_emitted = False
       self.requeues = 0
@@ -304,6 +340,10 @@ class FCFSScheduler:
       self._slot_blocks: Dict[int, List[int]] = {}
       self._tables = np.zeros((num_slots, self._mb), np.int32)
       self.preemptions = 0
+      # Eager evictions at admission so a latency-class arrival never
+      # queues behind a throughput slot's blocks (ROADMAP item 5
+      # leftover; _preempt_for_latency_admission).
+      self.proactive_preemptions = 0
     else:
       self.block_size = 0
       self.token_budget = 0
@@ -541,6 +581,115 @@ class FCFSScheduler:
       return None
     return self._retire(state, reason)
 
+  # ------------------------------------------------- snapshot / migration
+
+  @staticmethod
+  def _snapshot_state(req: Request, generated: List[int], requeues: int,
+                      first_token_emitted: bool,
+                      submitted_at: float) -> Dict[str, Any]:
+    return {
+        "request": req.snapshot(),
+        "generated": [int(t) for t in generated],
+        "requeues": int(requeues),
+        "first_token_emitted": bool(first_token_emitted),
+        "submitted_at": float(submitted_at),
+    }
+
+  def snapshot_requests(self) -> List[Dict[str, Any]]:
+    """Serializable snapshots of every IN-FLIGHT and queued request, in
+    service order (active slots by admission order, then the queue
+    front-to-back).  Each snapshot carries the request spec
+    (:meth:`Request.snapshot`) plus the mutable half — committed
+    generated prefix, requeue count, first-token flag, submit time —
+    which is everything bit-exact resumption needs: restoring on ANY
+    scheduler against the same params source replays prompt + prefix
+    through chunked prefill, reconstructing KV, cursors and the
+    ``tok_index`` PRNG fold exactly (module docstring: the requeue
+    contract, here made cross-replica).  Read-only — the scheduler is
+    untouched; pair with :meth:`evacuate` to also remove them."""
+    snaps = []
+    for slot in self._admit_order:
+      s = self.active[slot]
+      snaps.append(self._snapshot_state(
+          s.req, s.generated, s.requeues, s.first_token_emitted,
+          s.submitted_at))
+    for entry in self.pending:
+      c = entry.carried
+      snaps.append(self._snapshot_state(
+          entry.req, c.generated if c is not None else [],
+          c.requeues if c is not None else 0,
+          c.first_token_emitted if c is not None else False,
+          entry.submitted_at))
+    return snaps
+
+  def restore_request(self, snap: Dict[str, Any],
+                      front: bool = False) -> Any:
+    """Resubmit a snapshotted request (queued here, replayed through
+    chunked prefill on admission — the committed prefix and sample
+    stream resume bit-exactly).  ``front=True`` preserves the migrated
+    request's place in line (failover resubmits in REVERSE snapshot
+    order so the head of the dead replica's line stays the head here).
+    Returns the restored uid."""
+    req = Request.restore(snap["request"])
+    req = dataclasses.replace(req, prompt=self.validate(req))
+    submitted_at = float(snap["submitted_at"])
+    generated = [int(t) for t in snap.get("generated", ())]
+    carried = None
+    if generated or snap.get("requeues") or snap.get("first_token_emitted"):
+      # Rebuild the carried per-request state a requeue would have kept:
+      # the slot number is a placeholder (never read off a carried
+      # state) and the PRNG key re-derives from seed/uid — identical by
+      # _request_key's determinism.
+      carried = _SlotState(req, -1, submitted_at, self.clock())
+      carried.generated = generated
+      carried.requeues = int(snap.get("requeues", 0))
+      carried.first_token_emitted = bool(snap.get("first_token_emitted"))
+      carried.prefix = np.concatenate(
+          [req.prompt, np.asarray(generated, np.int32)])
+    entry = _Pending(req, submitted_at, carried=carried)
+    if front:
+      self.pending.appendleft(entry)
+    else:
+      self.pending.append(entry)
+    self._latency_pending += req.priority == "latency"
+    self._deadline_pending += self._has_deadline(req)
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.instant(
+          "serving/restore", cat="serving", track="serving/requests",
+          args={"uid": str(req.uid),
+                "committed_prefix": int(len(req.prompt) + len(generated))})
+    return req.uid
+
+  def evacuate(self) -> List[Dict[str, Any]]:
+    """Snapshot EVERY queued + in-flight request, then remove them all
+    without finish records (they will finish elsewhere — failover and
+    drain-timeout migration; router.py).  Slots, blocks and lifecycle
+    counters are released exactly as a requeue releases them; each
+    active request's trace span ends with reason ``"migrated"`` (like
+    ``"requeued"``/``"preempted"``, it names a move, not a final
+    resolution).  Call between steps only — never with a plan in
+    flight."""
+    snaps = self.snapshot_requests()
+    tracer = trace_lib.get_tracer()
+    for slot in list(self._admit_order):
+      state = self.active.pop(slot)
+      self._admit_order.remove(slot)
+      self.allocator.free(slot)
+      self._release_blocks(slot)
+      self._deadline_active -= self._has_deadline(state.req)
+      if tracer.enabled:
+        tracer.end(
+            f"request {state.req.uid}", cat="serving.request",
+            track=_slot_track(slot),
+            args={"finish_reason": "migrated",
+                  "new_tokens": int(len(state.generated))})
+    self.pending.clear()
+    self._latency_pending = 0
+    self._deadline_pending = 0
+    self._plan = None
+    return snaps
+
   # ----------------------------------------------------------------- plan
 
   def _next_pending_index(self) -> int:
@@ -567,13 +716,29 @@ class FCFSScheduler:
       budget_left -= sum(
           min(self.chunk, len(s.prefix) - s.prompt_pos)
           for s in self.active.values() if s.prefilling)
-    while (self.pending and self.allocator.num_free > 0
-           and len(self.active) < self.max_batch):
+    while self.pending:
       idx = self._next_pending_index()
       entry = self.pending[idx]
       first_chunk = min(self.chunk, entry.prefix_len)
       if budget_cap > 0 and budget_left < first_chunk:
         break
+      if (self.allocator.num_free == 0
+          or len(self.active) >= self.max_batch):
+        # Capacity-blocked.  Proactive latency-class preemption (paged
+        # engine): a latency arrival next in line evicts the youngest
+        # throughput slot holding blocks NOW rather than queueing until
+        # a retirement or pool exhaustion frees capacity.  The budget
+        # check above ran first — evicting for an admission this step
+        # cannot afford would burn the victim's progress for nothing.
+        if not (self.paged and self._latency_pending
+                and entry.req.priority == "latency"):
+          break
+        if self._preempt_for_latency_admission() is None:
+          break
+        # The victim re-entered the queue at its front; the latency
+        # entry's index may have shifted — re-resolve it.
+        idx = self._next_pending_index()
+        entry = self.pending[idx]
       budget_left -= first_chunk
       del self.pending[idx]
       self._latency_pending -= entry.req.priority == "latency"
@@ -646,34 +811,43 @@ class FCFSScheduler:
       self.block_allocator.decref(blk)
     self._tables[slot] = 0
 
-  def _preempt_for_blocks(self, requester: int,
-                          scheduled: set) -> Optional[int]:
-    """Page out one victim to refill the pool (satellite of ROADMAP
-    item 1: exhaustion preempts instead of raising).  Victim choice:
-    lowest priority class first, then the youngest admission — the
-    least-progress slot loses.  A victim must be strictly younger (or
-    lower-priority) than the requester and must not already hold
-    scheduled work in the plan being built (its in-flight writes would
-    race the reallocated blocks).  Returns the victim slot or None."""
-    req_state = self.active.get(requester)
-    if req_state is None:
-      return None
-    req_rank = (req_state.req.priority == "latency", -req_state.admit_seq)
+  def _preemption_victim(self, req_rank, excluded: set) -> Optional[int]:
+    """Shared eligibility rule for BOTH preemption paths (pool
+    exhaustion and proactive latency admission).  Victim choice: lowest
+    priority class first, then the youngest admission — the
+    least-progress slot loses.  A victim must rank strictly below the
+    requester (``(is_latency, -admit_seq)`` ordering, so two starving
+    peers can never preempt each other in a cycle), must not be in
+    ``excluded`` (the requester itself, or slots already holding
+    scheduled work in the plan being built — their in-flight writes
+    would race the reallocated blocks), and must actually hold blocks
+    (a blockless victim frees nothing: evicting it would requeue a
+    request — burning its queue position — without refilling the
+    pool)."""
     best = None
     best_rank = None
     for slot, state in self.active.items():
-      if slot == requester or slot in scheduled:
+      if slot in excluded:
         continue
       if not self._slot_blocks.get(slot):
-        # A blockless victim frees nothing: evicting it would requeue a
-        # request (and burn its queue position) without refilling the
-        # pool — the requester must starve instead.
         continue
       rank = (state.req.priority == "latency", -state.admit_seq)
       if rank >= req_rank:
         continue  # only strictly lower-priority-or-younger slots
       if best is None or rank < best_rank:
         best, best_rank = slot, rank
+    return best
+
+  def _preempt_for_blocks(self, requester: int,
+                          scheduled: set) -> Optional[int]:
+    """Page out one victim to refill the pool (satellite of ROADMAP
+    item 1: exhaustion preempts instead of raising).  Eligibility:
+    :meth:`_preemption_victim`.  Returns the victim slot or None."""
+    req_state = self.active.get(requester)
+    if req_state is None:
+      return None
+    req_rank = (req_state.req.priority == "latency", -req_state.admit_seq)
+    best = self._preemption_victim(req_rank, scheduled | {requester})
     if best is None:
       return None
     uid = self.active[best].req.uid
@@ -682,6 +856,35 @@ class FCFSScheduler:
         "KV block pool exhausted: preempting slot %d (uid %r) to refill "
         "it; the request replays its committed prefix on readmission",
         best, uid)
+    self.requeue_slot(best, reason="preempted")
+    return best
+
+  def _preempt_for_latency_admission(self) -> Optional[int]:
+    """Proactive latency-class preemption (ROADMAP item 5 leftover):
+    when a ``latency``-priority request is next in line but admission is
+    capacity-blocked (no free slot, or the batch cap is full), evict the
+    youngest throughput-class slot holding blocks NOW — eagerly, at
+    admission — instead of making the latency request wait for a natural
+    retirement or the pool to run dry.  Same eligibility rules as
+    exhaustion preemption (admission-seq ordering — an older
+    latency-class slot is never evicted for a younger latency arrival —
+    and draft headroom still never preempts: that rule lives in
+    ``_ensure_blocks(preempt=False)``, untouched here).  Returns the
+    victim slot or None; counted separately as
+    ``proactive_preemptions``."""
+    # The would-be admission's rank: strictly younger than every active
+    # slot, latency class — so exactly the throughput-class actives are
+    # eligible, youngest first.
+    req_rank = (True, -(self._admit_seq + 1))
+    best = self._preemption_victim(req_rank, set())
+    if best is None:
+      return None
+    uid = self.active[best].req.uid
+    self.proactive_preemptions += 1
+    get_logger().info(
+        "proactive preemption: evicting throughput slot %d (uid %r) to "
+        "admit a latency-class request; the victim replays its committed "
+        "prefix on readmission", best, uid)
     self.requeue_slot(best, reason="preempted")
     return best
 

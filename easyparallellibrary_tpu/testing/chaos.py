@@ -31,6 +31,19 @@ chaos-serve`) — the adversaries of serving/resilience.py:
 * overload — :func:`poisson_trace` (Poisson arrival offsets for
   admission-control / shedding episodes).
 
+Router-fleet faults (`tests/test_serving_router.py`, `make
+chaos-router`) — the adversaries of serving/router.py's control plane:
+
+* replica death — :class:`ReplicaKiller` (a fused-step dispatch raises
+  mid-decode; the router must fail the replica's queued + in-flight
+  requests over to survivors bit-exactly via prefix replay);
+* replica hangs — :class:`ReplicaHang` (stalled dispatches age the
+  heartbeat; the health machine must mark the replica suspect, route
+  around it, and recover on a clean beat);
+* flapping health — :class:`FlappingHealth` (periodic death/recovery;
+  the circuit breaker must double its hold-out per trip instead of
+  bouncing requests through endless failovers).
+
 These mutate real files, deliver real signals and poison real device
 calls; none of them are imported by library code.
 """
@@ -362,6 +375,79 @@ class FlakyDrafter:
 
   def observe_skip(self, plan) -> None:
     self.inner.observe_skip(plan)
+
+
+class ReplicaKiller(_StepFnWrapper):
+  """Kill a serving replica mid-decode: chosen fused-step dispatches
+  raise instead of returning — from the router's point of view the
+  replica died with requests in flight (the single-process stand-in for
+  SIGKILL: the device call never comes back, the exception unwinds the
+  replica's step, and only HOST state — the scheduler's committed
+  prefixes — survives for the control plane to recover).  The router
+  must mark the replica down, snapshot its queued + in-flight requests,
+  and resume every one on a survivor bit-exactly via prefix replay
+  (serving/router.py; `make chaos-router`).
+
+  ``kill_calls`` are 0-based device-call indices; each listed call
+  raises ONCE (so a later probe/rejoin of the same replica finds a
+  working engine — the transient-fault model; pass a long run of
+  indices for a persistent corpse, or use :class:`FlappingHealth` for
+  the periodic version)."""
+
+  def __init__(self, engine, kill_calls: Sequence[int]):
+    super().__init__(engine)
+    self.kill_calls = set(kill_calls)
+    self.kills = 0
+
+  def __call__(self, params, *args):
+    call, self.calls = self.calls, self.calls + 1
+    if call in self.kill_calls:
+      self.kill_calls.discard(call)
+      self.kills += 1
+      raise RuntimeError(f"chaos: replica killed mid-step "
+                         f"(device call {call})")
+    return self.inner(params, *args)
+
+
+class ReplicaHang(HangingStepInjector):
+  """Stall a replica's fused-step dispatches (same mechanism as
+  :class:`HangingStepInjector`, named for the router suite).  The
+  detector is the per-replica StepWatchdog — its monitor THREAD fires
+  during the stall (the synchronous router can't observe a hang it is
+  blocked inside), the timeout count rides the replica's next
+  heartbeat, and the health machine must mark the replica suspect (no
+  new dispatch; in-flight work keeps running and stays bit-exact),
+  recovering on the next clean beat.  A hang is a latency fault:
+  nothing is killed, nothing migrates, nothing may change in any
+  output stream."""
+
+
+class FlappingHealth(_StepFnWrapper):
+  """A replica that keeps dying and recovering: every ``fail_every``-th
+  fused-step dispatch raises (the rest succeed), so the router sees
+  down -> probe -> healthy -> down -> ... in a loop.  The circuit
+  breaker is the defense under test: each trip must DOUBLE the
+  hold-out before the next probe, so a flapping replica converges to
+  parked instead of bouncing its requests through endless failovers —
+  while every migrated request still finishes bit-exactly on the stable
+  survivors."""
+
+  def __init__(self, engine, fail_every: int = 4, start_at: int = 0):
+    if fail_every < 2:
+      raise ValueError(f"fail_every must be >= 2: {fail_every}")
+    super().__init__(engine)
+    self.fail_every = fail_every
+    self.start_at = start_at
+    self.faults = 0
+
+  def __call__(self, params, *args):
+    call, self.calls = self.calls, self.calls + 1
+    if call >= self.start_at and (call - self.start_at) \
+        % self.fail_every == self.fail_every - 1:
+      self.faults += 1
+      raise RuntimeError(f"chaos: flapping replica failed again "
+                         f"(device call {call})")
+    return self.inner(params, *args)
 
 
 def poisson_trace(rate_per_s: float, n: int, seed: int = 0,

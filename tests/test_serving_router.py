@@ -1,0 +1,794 @@
+"""Replicated serving control plane (ISSUE 8): health-checked router,
+bit-exact replica failover, graceful drain, replica-kill chaos.
+
+The acceptance contract (`make chaos-router`): with 2+ replicas,
+killing one mid-decode loses ZERO non-shed requests — every in-flight
+request on the dead replica finishes on a survivor with a greedy token
+stream bit-identical to the single-engine ``generate(use_cache=True)``
+oracle, and the survivor's fused-step compile count stays 1 throughout
+(failover is a prefix replay — no new shapes).  Graceful drain migrates
+or completes a replica's load within ``drain_timeout_s`` and rejoin
+resumes admission warm.  The heavyweight chaos episodes are
+``slow``-marked (tier-1 window budget — ROADMAP); ``make chaos-router``
+runs them all.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.models import GPT, GPTConfig
+from easyparallellibrary_tpu.models.gpt import generate
+from easyparallellibrary_tpu.observability.registry import MetricRegistry
+from easyparallellibrary_tpu.observability.report import (
+    fleet_rollup, format_fleet)
+from easyparallellibrary_tpu.profiler.serving import (
+    ServingStats, fleet_summary)
+from easyparallellibrary_tpu.serving import (
+    ContinuousBatchingEngine, FCFSScheduler, ReplicaHealth, Request,
+    Router)
+from easyparallellibrary_tpu.serving.scheduler import FinishedRequest
+from easyparallellibrary_tpu.testing import chaos
+from easyparallellibrary_tpu.utils.metrics_writer import MetricsWriter
+
+TINY = GPTConfig(vocab_size=64, num_layers=2, num_heads=4, d_model=32,
+                 d_ff=64, max_seq_len=32, dtype=jnp.float32)
+
+
+def _model_and_params(cfg=TINY, seed=0):
+  model = GPT(cfg)
+  params = model.init(jax.random.PRNGKey(seed),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+  return model, params
+
+
+def _prompts(lengths, vocab=64, seed=0):
+  r = np.random.RandomState(seed)
+  return [r.randint(0, vocab, (n,)).astype(np.int32) for n in lengths]
+
+
+def _oracle(model, params, prompt, max_new):
+  return np.asarray(
+      generate(model, params, jnp.asarray(prompt)[None], max_new))[0]
+
+
+def _router_config(**router):
+  return epl.Config({"serving": {"router": router}})
+
+
+class FakeClock:
+  def __init__(self, t: float = 0.0):
+    self.t = t
+
+  def __call__(self) -> float:
+    return self.t
+
+  def advance(self, dt: float):
+    self.t += dt
+
+
+class FakeReplica:
+  """Duck-typed replica for pure routing-policy tests (no device)."""
+
+  def __init__(self, index, load=0, num_slots=4):
+    self.index = index
+    self._load = load
+    self.num_slots = num_slots
+    self.submitted = []
+    self.restored = []
+    self.finished = {}
+    self.snaps = []
+    self.stats = None
+    self.accept = True
+    self.watchdog_timeouts = 0
+    self.bad_steps = 0
+    self.itl_ewma_s = 0.0
+    self.has_work = False
+
+  def submit(self, req):
+    if not self.accept:
+      self.finished[req.uid] = FinishedRequest(
+          uid=req.uid, tokens=np.asarray(req.prompt, np.int32),
+          new_tokens=0, finish_reason="shed")
+      return False
+    self.submitted.append(req.uid)
+    self._load += 1
+    return True
+
+  def cancel(self, uid):
+    return False
+
+  def step(self):
+    return []
+
+  @property
+  def load(self):
+    return self._load
+
+  @property
+  def queue_depth(self):
+    return 0
+
+  @property
+  def num_active(self):
+    return self._load
+
+  def snapshot_requests(self):
+    return list(self.snaps)
+
+  def evacuate(self):
+    snaps, self.snaps = self.snaps, []
+    self.has_work = False
+    return snaps
+
+  def restore_request(self, snap, front=False):
+    self.restored.append(snap["request"]["uid"])
+    return snap["request"]["uid"]
+
+  def close(self):
+    pass
+
+
+def _fake_router(loads, clock=None, **router_conf):
+  clock = clock or FakeClock()
+  reps = [FakeReplica(i, load=l) for i, l in enumerate(loads)]
+  r = Router(replicas=reps, config=_router_config(**router_conf),
+             clock=clock)
+  return r, reps, clock
+
+
+# ------------------------------------------------------- health machine
+
+
+def test_replica_health_state_machine():
+  """healthy -> suspect -> down by heartbeat age; a clean beat clears
+  suspect; a dirty beat (watchdog timeout / new bad steps / over-SLO
+  ITL) marks suspect even with a fresh heartbeat."""
+  clock = FakeClock()
+  h = ReplicaHealth(suspect_after=1.0, down_after=3.0, heartbeat_s=0.5,
+                    itl_slo_s=0.01, clock=clock)
+  assert h.state == "healthy" and h.routable
+  clock.advance(1.5)
+  assert h.observe() == "suspect" and not h.routable
+  h.beat()                                   # clean beat recovers
+  assert h.state == "healthy"
+  h.beat(watchdog_timeouts=1)                # dirty: answered but hung
+  assert h.state == "suspect"
+  h.beat()
+  assert h.state == "healthy"
+  h.beat(bad_steps=2)                        # new bad steps: dirty
+  assert h.state == "suspect"
+  h.beat(bad_steps=2)                        # same cumulative count: clean
+  assert h.state == "healthy"
+  h.beat(itl_s=0.5)                          # over SLO: suspect
+  assert h.state == "suspect"
+  clock.advance(4.0)
+  assert h.observe() == "down"
+  assert not h.routable and h.trips == 1
+  clock.advance(100.0)
+  assert h.observe() == "down"               # down is sticky
+
+
+def test_replica_health_breaker_backoff():
+  """Each trip to down doubles the probe hold-out (capped); rejoin is
+  refused before the cooldown, allowed after (or with force=True), and
+  note_stable forgives one trip."""
+  clock = FakeClock()
+  h = ReplicaHealth(suspect_after=1.0, down_after=2.0, clock=clock)
+  h.mark_down("chaos")
+  assert h.trips == 1 and h.cooldown_s() == 2.0
+  assert not h.can_probe() and not h.rejoin()
+  clock.advance(2.5)
+  assert h.can_probe() and h.rejoin() and h.state == "healthy"
+  h.mark_down("chaos again")
+  assert h.trips == 2 and h.cooldown_s() == 4.0
+  clock.advance(2.5)
+  assert not h.can_probe()                   # doubled hold-out binds
+  assert h.rejoin(force=True)                # operator override
+  h.note_stable()
+  assert h.trips == 1
+  # Drain is not a failure: no breaker trip, rejoin unconditional.
+  h.drain()
+  assert h.state == "draining" and not h.routable
+  assert h.rejoin() and h.state == "healthy" and h.trips == 1
+
+
+def test_replica_health_validation():
+  with pytest.raises(ValueError, match="suspect_after"):
+    ReplicaHealth(suspect_after=5.0, down_after=1.0)
+  with pytest.raises(ValueError, match="heartbeat_s"):
+    ReplicaHealth(heartbeat_s=0.0)
+
+
+def test_router_config_validation():
+  with pytest.raises(ValueError, match="replicas"):
+    _router_config(replicas=0)
+  with pytest.raises(ValueError, match="suspect_after"):
+    _router_config(suspect_after=5.0, down_after=1.0)
+  with pytest.raises(ValueError, match="heartbeat_s"):
+    _router_config(heartbeat_s=0.0)
+  with pytest.raises(ValueError, match="drain_timeout_s"):
+    _router_config(drain_timeout_s=-1.0)
+
+
+# -------------------------------------------------- snapshot / restore
+
+
+def test_request_snapshot_restore_round_trip():
+  """Request.snapshot()/restore() is lossless through JSON — sampling
+  knobs, lifecycle fields and the speculative opt-out flag included."""
+  req = Request(uid="r1", prompt=np.asarray([3, 1, 4], np.int32),
+                max_new_tokens=7, temperature=0.8, top_k=5, top_p=0.9,
+                stop_token=2, seed=11, speculative=False,
+                deadline_s=4.0, ttft_budget_s=1.5, priority="latency")
+  back = Request.restore(json.loads(json.dumps(req.snapshot())))
+  np.testing.assert_array_equal(back.prompt, req.prompt)
+  for f in ("uid", "max_new_tokens", "temperature", "top_k", "top_p",
+            "stop_token", "seed", "speculative", "deadline_s",
+            "ttft_budget_s", "priority"):
+    assert getattr(back, f) == getattr(req, f), f
+  # None-valued optionals survive too.
+  again = Request.restore(json.loads(json.dumps(Request(
+      uid=0, prompt=np.asarray([1], np.int32),
+      max_new_tokens=1).snapshot())))
+  assert again.seed is None and again.speculative is None
+
+
+def test_scheduler_snapshot_evacuate_restore_mid_flight():
+  """Scheduler-level migration currency: evacuate() drains queued AND
+  in-flight requests into JSON-serializable snapshots; restore on a
+  FRESH scheduler replays the committed prefix with the tok_index fold
+  intact (the bit-exactness precondition)."""
+  clock = FakeClock()
+  sched = FCFSScheduler(num_slots=1, prefill_chunk=4, max_seq_len=32,
+                        clock=clock)
+  a, b = _prompts((3, 5), seed=1)
+  sched.submit(Request(uid="fly", prompt=a, max_new_tokens=8))
+  sched.plan_step()                              # "fly" takes the slot
+  sched.commit(np.asarray([9], np.int32))        # prefix done + 1 token
+  sched.submit(Request(uid="wait", prompt=b, max_new_tokens=4))
+  snaps = json.loads(json.dumps(sched.evacuate()))
+  assert [s["request"]["uid"] for s in snaps] == ["fly", "wait"]
+  assert snaps[0]["generated"] == [9]
+  assert snaps[0]["first_token_emitted"] is True
+  assert snaps[1]["generated"] == []
+  assert not sched.has_work and sched.allocator.num_free == 1
+  dest = FCFSScheduler(num_slots=1, prefill_chunk=4, max_seq_len=32,
+                       clock=clock)
+  for snap in reversed(snaps):
+    dest.restore_request(snap, front=True)
+  assert [e.uid for e in dest.pending] == ["fly", "wait"]
+  plan = dest.plan_step()                        # replay = chunked prefill
+  np.testing.assert_array_equal(plan.tokens[0, :4], list(a) + [9])
+  assert plan.tok_index[0] == 1                  # PRNG fold continues
+  dest.commit(np.asarray([5], np.int32))
+  assert dest.active[0].generated == [9, 5]
+
+
+def test_snapshot_restore_preserves_sampled_stream():
+  """The PRNG fold-by-committed-token-index contract end to end: a
+  SAMPLED request interrupted mid-decode, snapshotted, JSON'd and
+  restored into the same engine finishes with a stream bit-identical to
+  the uninterrupted run (the key re-derives from the seed; the fold
+  index is the committed count — nothing else is state)."""
+  epl.init()
+  model, params = _model_and_params()
+  (p,) = _prompts((5,), seed=3)
+
+  def req(uid):
+    return Request(uid=uid, prompt=p, max_new_tokens=8,
+                   temperature=0.8, top_k=8, seed=42)
+
+  eng = ContinuousBatchingEngine(model, params, num_slots=1,
+                                 prefill_chunk=4)
+  eng.submit(req("ref"))
+  ref = eng.run()["ref"]
+  eng.submit(req("mig"))
+  for _ in range(4):                     # prefill + a few decode steps
+    eng.step()
+  (snap,) = json.loads(json.dumps(eng.snapshot_requests()))
+  assert 0 < len(snap["generated"]) < 8, "interrupt must be mid-decode"
+  assert eng.evacuate() and not eng.has_work
+  eng.restore_request(snap)
+  out = eng.run()
+  np.testing.assert_array_equal(out["mig"], ref)
+  assert eng._step_fn._cache_size() == 1
+
+
+# ---------------------------------------------- proactive preemption
+
+
+def _paged_sched(clock, num_slots=2, **kw):
+  kw.setdefault("block_size", 4)
+  kw.setdefault("num_blocks", 32)
+  kw.setdefault("token_budget", 8)
+  return FCFSScheduler(num_slots=num_slots, prefill_chunk=4,
+                       max_seq_len=16, clock=clock, **kw)
+
+
+def test_proactive_preemption_admits_latency_class():
+  """A latency-class arrival finding every slot held by throughput
+  requests evicts the YOUNGEST one eagerly at admission (not waiting
+  for pool exhaustion); the victim requeues with its prefix intact and
+  the eviction is counted as proactive, not exhaustion."""
+  clock = FakeClock()
+  sched = _paged_sched(clock)
+  a, b, c = _prompts((3, 3, 3), seed=2)
+  sched.submit(Request(uid="t0", prompt=a, max_new_tokens=8))
+  sched.submit(Request(uid="t1", prompt=b, max_new_tokens=8))
+  sched.plan_step()
+  sched.commit(np.asarray([[1], [1]], np.int32))
+  sched.submit(Request(uid="lat", prompt=c, max_new_tokens=4,
+                       priority="latency"))
+  sched.plan_step()
+  uids = {s.req.uid for s in sched.active.values()}
+  assert "lat" in uids and "t0" in uids and "t1" not in uids
+  assert sched.proactive_preemptions == 1
+  assert sched.preemptions == 0            # not an exhaustion event
+  assert sched.pending[0].uid == "t1"      # committed prefix carried
+  assert sched.pending[0].prefix_len == len(b) + 1
+
+
+def test_proactive_preemption_never_evicts_latency_or_unpaged():
+  """Eligibility: an older latency-class slot is never evicted for a
+  younger latency arrival (admission-seq ordering), and the contiguous
+  engine (no blocks to reclaim) never preempts proactively."""
+  clock = FakeClock()
+  sched = _paged_sched(clock, num_slots=1)
+  a, b = _prompts((3, 3), seed=4)
+  sched.submit(Request(uid="lat0", prompt=a, max_new_tokens=8,
+                       priority="latency"))
+  sched.plan_step()
+  sched.commit(np.asarray([[1]], np.int32))
+  sched.submit(Request(uid="lat1", prompt=b, max_new_tokens=4,
+                       priority="latency"))
+  sched.plan_step()
+  assert {s.req.uid for s in sched.active.values()} == {"lat0"}
+  assert sched.proactive_preemptions == 0
+  flat = FCFSScheduler(num_slots=1, prefill_chunk=4, max_seq_len=32,
+                       clock=clock)
+  flat.submit(Request(uid="t", prompt=a, max_new_tokens=8))
+  flat.plan_step()
+  flat.commit(np.asarray([[1]], np.int32))
+  flat.submit(Request(uid="lat", prompt=b, max_new_tokens=4,
+                      priority="latency"))
+  flat.plan_step()
+  assert {s.req.uid for s in flat.active.values()} == {"t"}
+
+
+# --------------------------------------------------------- fleet rollup
+
+
+def test_fleet_summary_merges_raw_samples_and_counters():
+  clock = FakeClock()
+  s1, s2 = ServingStats(clock=clock), ServingStats(clock=clock)
+  for stats, uid, ttft in ((s1, "a", 1.0), (s2, "b", 3.0)):
+    stats.note_submitted(uid)
+    clock.advance(ttft)
+    stats.note_first_token(uid)
+    clock.advance(1.0)
+    stats.note_finished(uid, 11, "length")
+    stats.note_step(active_slots=1, num_slots=2, prefill_tokens=0,
+                    decode_tokens=10, step_time_s=1.0)
+  s1.note_shed("x")
+  out = fleet_summary([s1, s2], {"failovers": 1, "router_shed": 2})
+  assert out["replicas"] == 2.0
+  assert out["finished_requests"] == 2.0
+  assert out["generated_tokens"] == 22.0
+  # Rates SUM across concurrently-serving replicas.
+  assert out["tokens_per_s"] == pytest.approx(11.0 + 11.0)
+  # Percentiles re-rank over merged raw samples: p50 of {1, 3} by
+  # nearest-rank is one of the samples, never their mean.
+  assert out["ttft_p50_s"] in (1.0, 3.0)
+  assert out["ttft_p99_s"] == 3.0
+  assert out["shed"] == 1.0 and out["router_shed"] == 2.0
+  assert out["failovers"] == 1.0
+  assert out["slot_occupancy_mean"] == pytest.approx(0.5)
+
+
+def test_fleet_rollup_report_reads_registry_jsonl(tmp_path):
+  """The report CLI's --metrics path: a Router-published serving/fleet
+  record round-trips through the registry's JSONL sink into the
+  formatted block (satellite: fleet rollup shown by
+  observability.report)."""
+  path = str(tmp_path / "metrics.jsonl")
+  writer = MetricsWriter(path)
+  registry = MetricRegistry(writer)
+  registry.publish(3, {"tokens_per_s": 12.5, "replicas": 2.0,
+                       "replicas_healthy": 1.0, "replicas_down": 1.0,
+                       "failovers": 1.0, "shed": 0.0}, "serving/fleet")
+  registry.publish(4, {"loss": 0.5}, "train")    # non-fleet line after
+  writer.close()
+  fleet = fleet_rollup(path)
+  assert fleet is not None and fleet["step"] == 3
+  assert fleet["tokens_per_s"] == 12.5
+  text = format_fleet(fleet)
+  assert "2 replica(s)" in text and "failovers 1" in text
+  assert fleet_rollup(str(tmp_path / "missing.jsonl")) is None
+
+
+# ------------------------------------------------ routing policy units
+
+
+def test_router_dispatch_least_loaded_and_affinity():
+  router, reps, _ = _fake_router([2, 0])
+  p1, p2 = _prompts((6, 6), seed=5)
+  assert router.submit(Request(uid="a", prompt=p1, max_new_tokens=2))
+  assert reps[1].submitted == ["a"]              # least-loaded wins
+  # Same prefix routes back to replica 1 (affinity) even once loads
+  # tie; a DIFFERENT prefix falls back to least-loaded.
+  reps[0]._load = 0
+  idx, reason = router._choose(np.asarray(p1, np.int32))
+  assert (idx, reason) == (1, "affinity")
+  idx, reason = router._choose(np.asarray(p2, np.int32))
+  assert (idx, reason) == (0, "least_loaded")
+  # A saturated affinity target is only a hint: fall back.
+  reps[1]._load = reps[1].num_slots
+  idx, reason = router._choose(np.asarray(p1, np.int32))
+  assert (idx, reason) == (0, "least_loaded")
+
+
+def test_router_dispatch_degrades_to_round_robin_on_stale_signals():
+  router, reps, clock = _fake_router([5, 0], heartbeat_s=1.0,
+                                     suspect_after=60.0,
+                                     down_after=120.0)
+  for r in reps:
+    r.has_work = True      # only a replica OWING work can go stale
+  clock.advance(5.0)       # no beats for 5s: stale but not yet suspect
+  choices = {router._choose(np.asarray([1, 2], np.int32))
+             for _ in range(4)}
+  assert all(reason == "round_robin" for _, reason in choices)
+  assert {idx for idx, _ in choices} == {0, 1}   # load 5 ranked no more
+
+
+def test_idle_fleet_never_ages_out_between_bursts():
+  """Regression: heartbeats only happen in step(), so a healthy fleet
+  idle past suspect_after/down_after must NOT be aged suspect/down at
+  the next submit — an idle replica owes no beats, and shedding the
+  first request after every lull would be self-inflicted unavailability.
+  """
+  router, reps, clock = _fake_router([0, 0])
+  clock.advance(10_000.0)                  # far past down_after
+  (p,) = _prompts((4,), seed=20)
+  assert router.submit(Request(uid="late", prompt=p, max_new_tokens=2))
+  assert router.states() == ["healthy", "healthy"]
+  assert router.router_shed == 0
+
+
+def test_stale_loaded_replica_reaped_at_submit():
+  """Regression: a replica HOLDING work whose heartbeat ages past
+  down_after without ever raising must be failed over at dispatch time
+  (the passive death path) — not skipped forever by the step loop's
+  down-guard, stranding its queue."""
+  router, reps, clock = _fake_router([1, 0])
+  reps[0].has_work = True
+  reps[0].snaps = [{"request": {"uid": "stranded", "prompt": [1, 2]},
+                    "generated": [], "requeues": 0,
+                    "first_token_emitted": False, "submitted_at": 0.0}]
+  clock.advance(1000.0)                    # past down_after, no beats
+  (p,) = _prompts((4,), seed=21)
+  assert router.submit(Request(uid="new", prompt=p, max_new_tokens=2))
+  assert router.state(0) == "down"
+  assert router.failovers == 1 and router.migrated_requests == 1
+  assert reps[1].restored == ["stranded"]
+  assert router.placement["stranded"] == 1
+  assert router.placement["new"] == 1      # routed around the corpse
+
+
+def test_cancel_reaches_parked_requests():
+  """Regression: a parked request (total outage) must be cancellable —
+  otherwise it silently resurrects on the next rejoin after the client
+  abandoned it."""
+  router, reps, _ = _fake_router([0])
+  router._parked.append({"request": {"uid": "p1", "prompt": [1, 2, 3]},
+                         "generated": [7], "requeues": 0,
+                         "first_token_emitted": True,
+                         "submitted_at": 0.0})
+  assert router.cancel("p1") is True
+  assert not router._parked
+  fin = router.finished["p1"]
+  assert fin.finish_reason == "cancelled" and fin.new_tokens == 1
+  np.testing.assert_array_equal(fin.tokens, [1, 2, 3, 7])
+  assert router.cancel("ghost") is False
+
+
+def test_router_sheds_when_no_replica_routable():
+  router, reps, _ = _fake_router([0, 0])
+  router.health[0].mark_down("chaos")
+  router.health[1].drain()
+  (p,) = _prompts((4,), seed=6)
+  assert router.submit(Request(uid="x", prompt=p, max_new_tokens=2)) \
+      is False
+  assert router.finished["x"].finish_reason == "shed"
+  assert router.router_shed == 1
+  assert router.fleet_summary()["router_shed"] == 1.0
+  # Replica-level shed is mirrored, not recounted.
+  router.health[1].rejoin()
+  reps[1].accept = False
+  assert not router.submit(Request(uid="y", prompt=p, max_new_tokens=2))
+  assert router.finished["y"].finish_reason == "shed"
+  assert router.router_shed == 1
+
+
+# ----------------------------------------------- engine: quick matrix
+
+
+@pytest.mark.quick
+def test_single_replica_router_fault_free_bit_exact_zero_recompile():
+  """Quick acceptance (ISSUE 8): a Router with N=1 and no faults is a
+  pure pass-through — token streams bit-identical to the bare engine
+  (and the generate() oracle) with the one fused step still compiled
+  ONCE (the control plane adds no device work)."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((5, 3, 9, 2))
+  max_new = (6, 7, 4, 5)
+
+  def drive(make):
+    eng_like = make()
+    for i in range(2):
+      assert eng_like.submit(Request(uid=i, prompt=prompts[i],
+                                     max_new_tokens=max_new[i]))
+    out = {}
+    for _ in range(2):
+      for fin in eng_like.step():
+        out[fin.uid] = fin.tokens
+    for i in range(2, 4):                        # staggered second wave
+      assert eng_like.submit(Request(uid=i, prompt=prompts[i],
+                                     max_new_tokens=max_new[i]))
+    out.update(eng_like.run())
+    return out
+
+  base = drive(lambda: ContinuousBatchingEngine(
+      model, params, num_slots=2, prefill_chunk=4))
+  router = Router(model, params, num_replicas=1, num_slots=2,
+                  prefill_chunk=4)
+  routed = drive(lambda: router)
+  assert router.replicas[0].engine._step_fn._cache_size() == 1
+  assert router.failovers == 0 and router.states() == ["healthy"]
+  assert sorted(base) == sorted(routed) == list(range(4))
+  for i in range(4):
+    np.testing.assert_array_equal(routed[i], base[i], err_msg=f"req {i}")
+    np.testing.assert_array_equal(
+        routed[i], _oracle(model, params, prompts[i], max_new[i]))
+    assert router.finished[i].finish_reason == "length"
+
+
+@pytest.mark.quick
+def test_replica_kill_mid_decode_bit_exact_failover():
+  """The headline (`make chaos-router` acceptance): kill one of two
+  replicas mid-decode — its queued + in-flight requests fail over to
+  the survivor and EVERY request finishes with the exact oracle stream;
+  the survivor's fused step stays compiled once (failover is a prefix
+  replay, not a new shape)."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((5, 3, 9, 2), seed=8)
+  router = Router(model, params, num_replicas=2, num_slots=2,
+                  prefill_chunk=4)
+  # Let replica 0 decode a few tokens before dying, so the failover
+  # carries COMMITTED MID-FLIGHT state, not just queued prompts.
+  killer = chaos.ReplicaKiller(router.replicas[0].engine,
+                               kill_calls=(3,))
+  for i, p in enumerate(prompts):
+    assert router.submit(Request(uid=i, prompt=p, max_new_tokens=6))
+  assert {router.placement[i] for i in range(4)} == {0, 1}
+  out = router.run()
+  assert killer.kills == 1
+  assert router.failovers == 1 and router.migrated_requests == 2
+  assert router.states() == ["down", "healthy"]
+  assert router.replicas[1].engine._step_fn._cache_size() == 1, \
+      "failover must not recompile the survivor's fused step"
+  assert len(router.finished) == 4
+  for i, p in enumerate(prompts):
+    assert router.finished[i].finish_reason == "length"
+    np.testing.assert_array_equal(out[i], _oracle(model, params, p, 6),
+                                  err_msg=f"req {i}")
+  fleet = router.fleet_summary()
+  assert fleet["finished_requests"] == 4.0      # nothing double-counted
+  assert fleet["failovers"] == 1.0
+
+
+# --------------------------------------------------- chaos: slow suite
+
+
+@pytest.mark.slow
+def test_graceful_drain_completes_then_rejoin_resumes():
+  """Drain with headroom: the draining replica finishes its own work
+  within the timeout (nothing migrates), stays unroutable until rejoin,
+  and rejoin resumes admission warm — zero recompiles across the whole
+  restart cycle."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((5, 3, 4, 6), seed=9)
+  router = Router(model, params, num_replicas=2, num_slots=2,
+                  prefill_chunk=4)
+  for i in range(3):
+    router.submit(Request(uid=i, prompt=prompts[i], max_new_tokens=6))
+  router.step()
+  drained = router.placement[0]
+  router.drain(drained)                    # default timeout: plenty
+  out = router.run()
+  assert router.migrated_requests == 0     # it finished its own load
+  assert router.state(drained) == "draining"
+  assert not router.replicas[drained].has_work
+  assert router.rejoin(drained)
+  assert router.state(drained) == "healthy"
+  # Rejoined replica takes traffic again, warm (compile count still 1).
+  other = 1 - drained
+  router.health[other].drain()
+  router.submit(Request(uid=3, prompt=prompts[3], max_new_tokens=6))
+  assert router.placement[3] == drained
+  out.update(router.run())
+  assert router.replicas[drained].engine._step_fn._cache_size() == 1
+  for i in range(4):
+    np.testing.assert_array_equal(
+        out[i], _oracle(model, params, prompts[i], 6), err_msg=f"req {i}")
+
+
+@pytest.mark.slow
+def test_drain_timeout_migrates_leftovers_bit_exact():
+  """Drain with NO headroom (timeout 0): the replica's queued and
+  in-flight requests migrate to the survivor immediately and still
+  finish bit-exactly — the rolling-restart worst case."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((5, 3, 9, 2), seed=10)
+  router = Router(model, params, num_replicas=2, num_slots=2,
+                  prefill_chunk=4)
+  for i, p in enumerate(prompts):
+    router.submit(Request(uid=i, prompt=p, max_new_tokens=6))
+  router.step()                            # both replicas mid-flight
+  drained = 0
+  router.drain(drained, timeout_s=0.0)
+  out = router.run()
+  assert router.migrated_requests >= 1
+  assert len(out) == 4 and len(router.finished) == 4
+  for i, p in enumerate(prompts):
+    assert router.finished[i].finish_reason == "length"
+    np.testing.assert_array_equal(out[i], _oracle(model, params, p, 6),
+                                  err_msg=f"req {i}")
+  assert router.replicas[1].engine._step_fn._cache_size() == 1
+  # The degradation/shed ledger stayed consistent: nothing shed, every
+  # submit resolved exactly once.
+  assert router.fleet_summary()["shed"] == 0.0
+  assert router.fleet_summary()["finished_requests"] == 4.0
+
+
+@pytest.mark.slow
+def test_replica_hang_marks_suspect_outputs_exact():
+  """A hung replica step trips ITS StepWatchdog (the async detector);
+  the timeout count rides the next heartbeat and the health machine
+  marks the replica suspect, recovering on the next clean beat — a
+  latency fault only, streams stay bit-exact and nothing migrates."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((5, 3), seed=11)
+  config = epl.Config({"serving": {"resilience": {
+      "enabled": True, "step_timeout_s": 0.05}}})
+  router = Router(model, params, num_replicas=2, num_slots=2,
+                  prefill_chunk=4, config=config)
+  try:
+    inj = chaos.ReplicaHang(router.replicas[0].engine, hang_calls=(1,),
+                            hang_s=0.4)
+    transitions = []
+    router.health[0].on_transition = \
+        lambda old, new, reason: transitions.append((old, new))
+    for i, p in enumerate(prompts):
+      router.submit(Request(uid=i, prompt=p, max_new_tokens=6))
+    out = router.run()
+  finally:
+    router.close()
+  assert inj.hangs == 1
+  assert router.replicas[0].stats.watchdog_timeouts >= 1
+  assert ("healthy", "suspect") in transitions
+  assert ("suspect", "healthy") in transitions  # clean beat recovered it
+  assert router.failovers == 0 and router.migrated_requests == 0
+  for i, p in enumerate(prompts):
+    np.testing.assert_array_equal(out[i], _oracle(model, params, p, 6),
+                                  err_msg=f"req {i}")
+
+
+@pytest.mark.slow
+def test_flapping_replica_breaker_backoff():
+  """A replica that keeps dying and rejoining: every trip doubles the
+  breaker hold-out, so the flapper converges to parked while the stable
+  survivor serves everything bit-exactly."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((5, 3, 4, 6, 2, 7), seed=12)
+  clock = FakeClock()
+  router = Router(model, params, num_replicas=2, num_slots=2,
+                  prefill_chunk=4, clock=clock)
+  chaos.FlappingHealth(router.replicas[0].engine, fail_every=2)
+  h = router.health[0]
+  seen_cooldowns = []
+  next_uid = 0
+  for _ in range(400):
+    if (h.state == "healthy" and next_uid < len(prompts)
+        and not router.replicas[0].has_work):
+      # Keep handing the flapper work each time it claims recovery —
+      # the flap only reproduces under load.
+      router.replicas[0].submit(Request(uid=next_uid,
+                                        prompt=prompts[next_uid],
+                                        max_new_tokens=6))
+      next_uid += 1
+    if h.state == "down":
+      if not seen_cooldowns or seen_cooldowns[-1] != h.cooldown_s():
+        seen_cooldowns.append(h.cooldown_s())
+      clock.advance(h.cooldown_s() + 1.0)   # let the breaker probe
+    router.step()
+    if next_uid >= len(prompts) and not router.has_work:
+      break
+  assert not router.has_work
+  assert h.trips >= 2, "flapper must trip the breaker repeatedly"
+  # Exponential hold-out: each successive cooldown doubled.
+  assert seen_cooldowns == sorted(seen_cooldowns)
+  assert len(seen_cooldowns) >= 2
+  assert seen_cooldowns[1] == 2 * seen_cooldowns[0]
+  assert router.probes >= 1
+  for i, p in enumerate(prompts):
+    assert router.finished[i].finish_reason == "length"
+    np.testing.assert_array_equal(
+        router.finished[i].tokens, _oracle(model, params, p, 6),
+        err_msg=f"req {i}")
+
+
+@pytest.mark.slow
+def test_total_outage_parks_requests_until_rejoin():
+  """Killing the ONLY replica parks its requests (an outage delays,
+  never loses); a forced rejoin flushes the parked backlog and every
+  request still finishes bit-exactly."""
+  epl.init()
+  model, params = _model_and_params()
+  prompts = _prompts((5, 3), seed=13)
+  router = Router(model, params, num_replicas=1, num_slots=2,
+                  prefill_chunk=4)
+  chaos.ReplicaKiller(router.replicas[0].engine, kill_calls=(2,))
+  for i, p in enumerate(prompts):
+    router.submit(Request(uid=i, prompt=p, max_new_tokens=6))
+  out = router.run()                       # returns: everything parked
+  assert not out and router.states() == ["down"]
+  assert router.router_counters()["parked"] == 2.0
+  assert len(router.finished) == 0, "parked requests are NOT resolved"
+  assert router.rejoin(0, force=True)
+  out = router.run()
+  assert router.router_counters()["parked"] == 0.0
+  for i, p in enumerate(prompts):
+    assert router.finished[i].finish_reason == "length"
+    np.testing.assert_array_equal(out[i], _oracle(model, params, p, 6),
+                                  err_msg=f"req {i}")
+  assert router.replicas[0].engine._step_fn._cache_size() == 1
+
+
+@pytest.mark.slow
+def test_proactive_preemption_on_paged_engine_bit_exact():
+  """Device-level proactive preemption: on the paged engine a
+  latency-class arrival evicts a running throughput slot at admission;
+  BOTH requests still finish bit-exact vs the oracle (the victim
+  replays its committed prefix) and the eviction is counted under
+  serving/proactive_preemptions."""
+  epl.init()
+  model, params = _model_and_params()
+  lat_p, t0_p, t1_p = _prompts((4, 5, 3), seed=14)
+  eng = ContinuousBatchingEngine(
+      model, params, num_slots=2, prefill_chunk=4, paged=True,
+      block_size=4, token_budget=12, resilience=True)
+  eng.submit(Request(uid="t0", prompt=t0_p, max_new_tokens=8))
+  eng.submit(Request(uid="t1", prompt=t1_p, max_new_tokens=8))
+  for _ in range(4):
+    eng.step()                             # both throughput mid-decode
+  eng.submit(Request(uid="lat", prompt=lat_p, max_new_tokens=4,
+                     priority="latency"))
+  out = eng.run()
+  assert eng.scheduler.proactive_preemptions == 1
+  assert eng.stats.proactive_preemptions == 1
+  assert eng._step_fn._cache_size() == 1
+  for uid, p, mx in (("t0", t0_p, 8), ("t1", t1_p, 8), ("lat", lat_p, 4)):
+    assert eng.finished[uid].finish_reason == "length"
+    np.testing.assert_array_equal(
+        out[uid], _oracle(model, params, p, mx), err_msg=uid)
