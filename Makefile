@@ -22,9 +22,12 @@ chaos-serve:
 
 # Router chaos: replica kills mid-decode, replica hangs, flapping health
 # against the multi-replica control plane — bit-exact failover, graceful
-# drain/rejoin, circuit breaker (docs/serving.md "Multi-replica serving").
+# drain/rejoin, circuit breaker (docs/serving.md "Multi-replica serving")
+# — plus the fleet observability acceptance (one connected flow per
+# migrated request, SLO breach window logged, diagnostic bundle
+# captured; docs/observability.md "Reading a failover trace").
 chaos-router:
-	python -m pytest tests/test_serving_router.py -q
+	python -m pytest tests/test_serving_router.py tests/test_observability_fleet.py -q
 
 # Continuous batching vs static-batch generate() under Poisson arrivals
 # (benchmarks/decode_throughput.py -> BENCH_EVIDENCE.json; docs/serving.md).
@@ -56,12 +59,38 @@ overload-bench:
 router-bench:
 	python benchmarks/router_failover.py
 
-# Tiny traced fit() + serving episode on the CPU mesh -> trace_demo.json
-# (schema-validated; load at ui.perfetto.dev; docs/observability.md).
+# Tiny traced fit() + serving + router-failover episode on the CPU mesh
+# -> trace_demo.json (schema-validated incl. request-flow events; load
+# at ui.perfetto.dev; docs/observability.md).
 trace-demo:
 	python benchmarks/trace_demo.py
+
+# Re-measure the observability layer's serving overhead (tracer + SLO
+# monitor + compile sentinel vs bare engine, interleaved per-step
+# samples) and append the <=5% evidence to BENCH_EVIDENCE.json
+# (benchmarks/obs_overhead.py; docs/observability.md).
+obs-bench:
+	python benchmarks/obs_overhead.py
+
+help:
+	@echo "Targets:"
+	@echo "  build          - build the native IO extension (csrc/)"
+	@echo "  test           - full pytest suite (stops on first failure)"
+	@echo "  bench          - official perf capture (bench.py)"
+	@echo "  chaos          - training fault-injection suite"
+	@echo "  chaos-serve    - serving resilience chaos (NaN/hang/overload)"
+	@echo "  chaos-router   - fleet chaos: replica kills, hangs, flapping health"
+	@echo "  serve-bench    - continuous batching vs static generate()"
+	@echo "  paged-bench    - paged vs contiguous KV cache (long-tail trace)"
+	@echo "  spec-bench     - speculative vs plain decode"
+	@echo "  overload-bench - admission control under Poisson overload"
+	@echo "  router-bench   - replica-kill failover episode (0 lost requests)"
+	@echo "  trace-demo     - emit + validate a demo trace (fit/serving/failover)"
+	@echo "  obs-bench      - tracer+SLO overhead evidence (<=5% budget)"
+	@echo "  clean          - clean native build artifacts"
+	@echo "Live watching: python -m easyparallellibrary_tpu.observability.report --follow <metrics.jsonl>"
 
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test bench chaos chaos-serve chaos-router serve-bench paged-bench spec-bench overload-bench router-bench trace-demo clean
+.PHONY: all build test bench chaos chaos-serve chaos-router serve-bench paged-bench spec-bench overload-bench router-bench trace-demo obs-bench help clean

@@ -1,16 +1,24 @@
-"""Emit a demo trace: a tiny ``fit()`` plus a serving episode, traced.
+"""Emit a demo trace: a tiny ``fit()``, a serving episode, and a
+router failover episode — all traced into one timeline.
 
 ``make trace-demo`` runs this on the CPU mesh: a few training steps
-(with a mid-run checkpoint, so the stage/commit spans appear), then a
+(with a mid-run checkpoint, so the stage/commit spans appear), a
 speculative continuous-batching episode with staggered admissions (so
 per-request lifecycle tracks with prefill / speculate spans appear),
-all recorded by ONE ambient tracer into one timeline.  The script
+then a TWO-REPLICA router episode with one injected replica kill
+mid-decode (testing/chaos.ReplicaKiller) — so the exported trace
+carries the fleet-grade artifacts: per-replica slot tracks, a
+``serving/failover`` instant, and REQUEST-FLOW events rendering each
+migrated request as one connected arc across both replicas' tracks
+(docs/observability.md "Reading a failover trace").  The SLO monitor
+runs alongside and writes its breach log.  The script
 
   * exports the Chrome-trace / Perfetto JSON (``trace_demo.json`` by
     default — load it at ``ui.perfetto.dev``),
   * schema-validates it (``observability.trace.validate_trace`` — the
-    same validator the quick test runs), and
-  * prints the latency-breakdown report
+    same validator the quick test runs, INCLUDING the flow schema:
+    every started flow terminates), and
+  * prints the latency-breakdown report plus the SLO event log
     (``python -m easyparallellibrary_tpu.observability.report``).
 
 ``run_demo()`` is importable: tests/test_observability.py drives it for
@@ -60,11 +68,16 @@ def run_demo(out_path: str, workdir: str = "") -> str:
   from easyparallellibrary_tpu.profiler import ServingStats
   from easyparallellibrary_tpu.runtime.loop import fit
   from easyparallellibrary_tpu.serving import (
-      ContinuousBatchingEngine, NgramDrafter, Request)
+      ContinuousBatchingEngine, NgramDrafter, Request, Router)
+  from easyparallellibrary_tpu.testing import chaos
 
   workdir = workdir or tempfile.mkdtemp(prefix="epl_trace_demo_")
   epl.init(epl.Config({"observability": {
-      "enabled": True, "trace_path": out_path}}))
+      "enabled": True, "trace_path": out_path,
+      "slo": {"enabled": True,
+              "events_path": os.path.join(workdir, "slo_events.jsonl"),
+              "capture_dir": os.path.join(workdir, "diag"),
+              "capture_min_interval_s": 0.0}}}))
   tracer = trace_lib.ensure_configured()
 
   # --- tiny fit(): data-next / dispatch / checkpoint spans -------------
@@ -119,6 +132,24 @@ def run_demo(out_path: str, workdir: str = "") -> str:
                        max_new_tokens=6))
   eng.run()
 
+  # --- fleet episode: 2 replicas, one killed mid-decode ----------------
+  # The failover migrates replica 0's queued + in-flight requests to the
+  # survivor via prefix replay; the trace renders each migrated request
+  # as ONE connected flow arc across both replicas' slot tracks, the
+  # SLO monitor logs the replica_down breach window, and a diagnostic
+  # bundle lands under <workdir>/diag.
+  router = Router(gpt, params, num_replicas=2, num_slots=2,
+                  prefill_chunk=4)
+  killer = chaos.ReplicaKiller(router.replicas[0].engine,
+                               kill_calls=(2,))
+  for i in range(4):
+    router.submit(Request(uid=f"fleet{i}", prompt=prompts[i],
+                          max_new_tokens=6))
+  router.run()
+  router.close()
+  assert killer.kills == 1 and router.failovers == 1, \
+      "demo kill episode did not fail over as scripted"
+
   return tracer.export(out_path)
 
 
@@ -127,11 +158,22 @@ def main(argv=None) -> int:
   from easyparallellibrary_tpu.observability.trace import validate_trace
   argv = sys.argv[1:] if argv is None else argv
   out = argv[0] if argv else "trace_demo.json"
-  path = run_demo(out)
+  workdir = tempfile.mkdtemp(prefix="epl_trace_demo_")
+  path = run_demo(out, workdir=workdir)
   events = validate_trace(path)
-  print(f"trace OK: {len(events)} events -> {path} "
-        f"(load at ui.perfetto.dev)\n")
+  flows = {e["id"] for e in events if e.get("ph") == "s"}
+  print(f"trace OK: {len(events)} events, {len(flows)} request flows "
+        f"-> {path} (load at ui.perfetto.dev)\n")
   print(report.format_report(report.load_events(path)))
+  slo_path = os.path.join(workdir, "slo_events.jsonl")
+  if os.path.exists(slo_path):
+    print(f"\nSLO events ({slo_path}):")
+    with open(slo_path) as f:
+      for line in f:
+        print("  " + line.rstrip())
+  diag = os.path.join(workdir, "diag")
+  if os.path.isdir(diag):
+    print(f"diagnostic bundles: {sorted(os.listdir(diag))} -> {diag}")
   return 0
 
 

@@ -564,7 +564,55 @@ class ObservabilityConfig(_Category):
       # MetricRegistry (train/* + resilience/* keys), so runs are never
       # silently unlogged.  An explicitly passed writer always wins.
       "metrics_jsonl": True,
+      # --- SLO monitoring & anomaly-triggered deep capture
+      # (observability/slo.py, docs/observability.md "SLO monitoring").
+      # Master switch: the serving engine and router build/attach the
+      # ambient SLOMonitor at entry when on; every breach/recovery is a
+      # slo_events.jsonl line + slo/breach trace instant + listener
+      # callback.  Off keeps every record path byte-identical.
+      "slo.enabled": False,
+      # Machine-readable breach/recovery log ("" = memory + trace only).
+      "slo.events_path": "",
+      # Threshold rules (0 = rule off).  Bare-name metric matching:
+      # each target evaluates against the fleet rollup, every
+      # serving/replica<i>/* record, AND a bare engine's serving/*
+      # records, as separate breach streams.
+      "slo.ttft_p99_s": 0.0,
+      "slo.itl_p99_s": 0.0,
+      # Shed-rate error budget: promised non-shed fraction (e.g. 0.99 =
+      # at most 1% of requests may shed; 0 = rule off), evaluated as
+      # multi-window burn rates over the last fast_window / slow_window
+      # records — both must exceed their thresholds to breach.
+      "slo.shed_objective": 0.0,
+      "slo.fast_window": 5,
+      "slo.slow_window": 20,
+      "slo.fast_burn": 10.0,
+      "slo.slow_burn": 2.0,
+      # Fleet availability rule: any replicas_down > 0 in the fleet
+      # rollup is a breach window (the failover acceptance signal).
+      "slo.replicas_down": True,
+      # Anomaly-triggered deep capture: on breach / watchdog fire /
+      # recompile, dump a bounded diagnostic bundle (tracer ring tail,
+      # registry snapshot, scheduler state summary) into this dir
+      # ("" = capture off), staged + atomically renamed, keeping at
+      # most capture_limit bundles and at most one per
+      # capture_min_interval_s (a flapping fleet cannot fill the disk).
+      "slo.capture_dir": "",
+      "slo.capture_limit": 8,
+      "slo.capture_min_interval_s": 30.0,
+      "slo.capture_ring_tail": 2048,
+      # Also arm a jax.profiler device capture around the NEXT fused
+      # step after an ENGINE-ATTRIBUTED breach (recompile / watchdog —
+      # the payload's twin names the engine; fleet-level rule breaches
+      # arm nothing, lest one kill device-profile every healthy
+      # replica).  Written under <bundle>/xla.  Off by default: device
+      # captures are heavy.
+      "slo.capture_xla": False,
   }
+
+  @property
+  def slo(self) -> _SubGroup:
+    return _SubGroup(self, "slo")
 
 
 class Config:
@@ -739,6 +787,31 @@ class Config:
     if not 0.0 < self.observability.sample_rate <= 1.0:
       raise ValueError(f"observability.sample_rate must be in (0, 1]; "
                        f"got {self.observability.sample_rate}")
+    slo = self.observability.slo
+    for field in ("ttft_p99_s", "itl_p99_s", "capture_min_interval_s"):
+      if getattr(slo, field) < 0:
+        raise ValueError(f"observability.slo.{field} must be >= 0 "
+                         f"(0 = off); got {getattr(slo, field)}")
+    if not 0.0 <= slo.shed_objective < 1.0:
+      raise ValueError(
+          f"observability.slo.shed_objective must be in [0, 1) (0 = "
+          f"rule off); got {slo.shed_objective}")
+    if not 1 <= slo.fast_window <= slo.slow_window:
+      raise ValueError(
+          f"observability.slo needs 1 <= fast_window <= slow_window; "
+          f"got fast_window={slo.fast_window}, "
+          f"slow_window={slo.slow_window}")
+    if slo.fast_burn <= 0 or slo.slow_burn <= 0:
+      raise ValueError(
+          f"observability.slo burn thresholds must be > 0; got "
+          f"fast_burn={slo.fast_burn}, slow_burn={slo.slow_burn}")
+    if slo.capture_limit < 1:
+      raise ValueError(f"observability.slo.capture_limit must be >= 1; "
+                       f"got {slo.capture_limit}")
+    if slo.capture_ring_tail < 1:
+      raise ValueError(
+          f"observability.slo.capture_ring_tail must be >= 1; got "
+          f"{slo.capture_ring_tail}")
     if spec.enabled and spec.k + 1 > self.serving.prefill_chunk:
       raise ValueError(
           f"serving.speculative.k={spec.k} needs serving.prefill_chunk "

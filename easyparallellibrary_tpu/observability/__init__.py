@@ -11,14 +11,21 @@ One event substrate for the whole runtime:
   ``MetricsWriter`` / ``TensorBoardWriter`` sinks;
 * :mod:`report` — ``python -m easyparallellibrary_tpu.observability
   .report <trace>`` latency-breakdown summaries, including per-request
-  serving timelines.
+  serving timelines (``--follow`` tails a live metrics JSONL);
+* :mod:`slo` — declarative SLO rules over the registry records, the
+  always-on compile sentinel, and anomaly-triggered diagnostic-bundle
+  capture (``observability.slo.*``).
 
 Knobs: the ``observability.*`` config group (enabled / trace_path /
-ring_capacity / sample_rate / metrics_jsonl).
+ring_capacity / sample_rate / metrics_jsonl / slo.*).
 """
 
 from easyparallellibrary_tpu.observability.registry import (
     NAMESPACES, MetricRegistry, split_namespaces,
+)
+from easyparallellibrary_tpu.observability.slo import (
+    BurnRateRule, CompileSentinel, DiagnosticCapture, SLOMonitor,
+    SLORule, get_monitor,
 )
 from easyparallellibrary_tpu.observability.trace import (
     Tracer, ensure_configured, get_tracer, install, validate_trace,
@@ -26,6 +33,8 @@ from easyparallellibrary_tpu.observability.trace import (
 
 __all__ = [
     "MetricRegistry", "NAMESPACES", "split_namespaces",
+    "BurnRateRule", "CompileSentinel", "DiagnosticCapture",
+    "SLOMonitor", "SLORule", "get_monitor",
     "Tracer", "ensure_configured", "get_tracer", "install",
     "validate_trace",
 ]

@@ -88,6 +88,15 @@ class MetricRegistry:
     self._sinks.append(sink)
     return sink
 
+  def add_sink_once(self, sink):
+    """Idempotent :meth:`add_sink` — the SLO monitor attaches itself to
+    whatever registry each serving component holds, and N replicas
+    sharing one registry must not multiply every record N ways
+    (observability/slo.py)."""
+    if sink not in self._sinks:
+      self._sinks.append(sink)
+    return sink
+
   @staticmethod
   def namespaced(namespace: str, metrics: Mapping[str, Any]
                  ) -> Dict[str, Any]:

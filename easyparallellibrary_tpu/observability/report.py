@@ -13,20 +13,30 @@ prints, without leaving the terminal for Perfetto:
   ``serving/fleet/*`` record a multi-replica Router published through
   the registry (tokens/s summed, merged TTFT/ITL percentiles,
   shed/failover counters, replica state counts; docs/serving.md
-  "Multi-replica serving").
+  "Multi-replica serving");
+* with ``--follow <metrics.jsonl>``, **tail mode** — re-render the
+  fleet rollup and SLO status as records append, so a live
+  ``make chaos-router`` run is watched AS the kill and failover happen
+  instead of post-mortem.  ``--slo <slo_events.jsonl>`` adds the SLO
+  monitor's breach/recovery stream (auto-detected when a sibling
+  ``slo_events.jsonl`` exists); Ctrl-C exits cleanly.
 
 Reads the Chrome-trace JSON the tracer exports (observability/trace.py)
 — and nothing else; the report is a pure function of the artifact, so
 it works on traces mailed in from another machine.  Unmatched B/E
 events (a ring buffer that wrapped mid-span) are skipped and counted
-rather than fatal — post-mortems read partial traces.
+rather than fatal — post-mortems read partial traces, and tail mode
+reads mid-write files (partial trailing lines are left for the next
+poll).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from easyparallellibrary_tpu.profiler.serving import percentile
@@ -240,9 +250,133 @@ def format_fleet(fleet: Dict[str, Any]) -> str:
       f"probes {g('probes'):.0f}, parked {g('parked'):.0f}, "
       f"requeues {g('requeues'):.0f}, "
       f"preemptions {g('preemptions'):.0f} "
-      f"(+{g('proactive_preemptions'):.0f} proactive)",
+      f"(+{g('proactive_preemptions'):.0f} proactive), "
+      f"recompiles {g('recompiles'):.0f}",
   ]
   return "\n".join(lines)
+
+
+class FollowState:
+  """Incremental tail over a registry metrics JSONL (and optionally the
+  SLO monitor's ``slo_events.jsonl``): each :meth:`poll` consumes only
+  the bytes appended since the last one — COMPLETE lines only, a
+  partial trailing line (the sink may be mid-write) waits for the next
+  poll — and returns a rendered status block when anything changed,
+  else None.  Pure state machine, no sleeping: :func:`follow` owns the
+  loop so tests can drive polls directly."""
+
+  def __init__(self, metrics_path: str, slo_path: Optional[str] = None):
+    self.metrics_path = metrics_path
+    self.slo_path = slo_path
+    self._offsets: Dict[str, int] = {}
+    self.records = 0
+    self.last_step: Optional[int] = None
+    self.last_fleet: Optional[Dict[str, Any]] = None
+    self.slo_breaches = 0
+    # rule@metric -> last breach/recover event (current stream state;
+    # bounded — a follow session is meant to run for days, so it keeps
+    # state per RULE STREAM, never per event).
+    self.slo_state: Dict[str, Dict[str, Any]] = {}
+    self._polls = 0
+
+  def _read_new_lines(self, path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    try:
+      with open(path, "rb") as f:
+        offset = self._offsets.get(path, 0)
+        size = os.fstat(f.fileno()).st_size
+        if size < offset:
+          # The file shrank: truncated or rotated under us.  Restart
+          # from the top rather than seeking past EOF and going
+          # permanently silent.
+          offset = self._offsets[path] = 0
+        f.seek(offset)
+        chunk = f.read()
+    except OSError:
+      return out
+    consumed = chunk.rfind(b"\n") + 1  # whole lines only
+    if consumed <= 0:
+      return out
+    self._offsets[path] = self._offsets.get(path, 0) + consumed
+    for line in chunk[:consumed].splitlines():
+      try:
+        rec = json.loads(line)
+      except ValueError:
+        continue
+      if isinstance(rec, dict):
+        out.append(rec)
+    return out
+
+  def poll(self) -> Optional[str]:
+    changed = False
+    prefix = "serving/fleet/"
+    for rec in self._read_new_lines(self.metrics_path):
+      self.records += 1
+      changed = True
+      self.last_step = rec.get("step", self.last_step)
+      fleet = {k[len(prefix):]: v for k, v in rec.items()
+               if k.startswith(prefix)}
+      if fleet:
+        fleet["step"] = rec.get("step")
+        self.last_fleet = fleet
+    if self.slo_path:
+      for ev in self._read_new_lines(self.slo_path):
+        changed = True
+        self.slo_breaches += ev.get("event") == "breach"
+        key = f"{ev.get('rule', '?')}@{ev.get('metric', '-')}"
+        self.slo_state[key] = ev
+    self._polls += 1
+    if not changed and self._polls > 1:
+      return None
+    return self.render()
+
+  def render(self) -> str:
+    lines = [f"--- {time.strftime('%H:%M:%S')}  {self.records} "
+             f"record(s), last step {self.last_step if self.last_step is not None else '-'}"]
+    if self.last_fleet is not None:
+      lines.append(format_fleet(self.last_fleet))
+    else:
+      lines.append("(no serving/fleet/* record yet)")
+    if self.slo_path:
+      if not self.slo_state:
+        lines.append("SLO: no events")
+      else:
+        parts = []
+        for key, ev in sorted(self.slo_state.items()):
+          state = "BREACH" if ev.get("event") == "breach" else "ok"
+          detail = ""
+          if "value" in ev:
+            detail = f" (value {ev['value']:.4g} vs {ev.get('target')})"
+          elif "fast_burn" in ev:
+            detail = f" (burn {ev['fast_burn']:.2g}x)"
+          parts.append(f"{key}: {state}{detail}")
+        lines.append(f"SLO [{self.slo_breaches} breach event(s)]: "
+                     + "; ".join(parts))
+    return "\n".join(lines)
+
+
+def follow(metrics_path: str, slo_path: Optional[str] = None,
+           interval_s: float = 2.0, max_polls: int = 0,
+           out=None) -> FollowState:
+  """Tail loop over :class:`FollowState` (``report.py --follow``):
+  re-print the fleet rollup + SLO status whenever records append.
+  ``max_polls`` bounds the loop (0 = until Ctrl-C); returns the final
+  state for callers that inspect it."""
+  out = out if out is not None else print
+  state = FollowState(metrics_path, slo_path)
+  polls = 0
+  try:
+    while True:
+      block = state.poll()
+      if block is not None:
+        out(block)
+      polls += 1
+      if max_polls and polls >= max_polls:
+        break
+      time.sleep(interval_s)
+  except KeyboardInterrupt:
+    pass
+  return state
 
 
 def format_report(events: List[Dict[str, Any]]) -> str:
@@ -299,12 +433,37 @@ def main(argv: Optional[List[str]] = None) -> int:
       prog="python -m easyparallellibrary_tpu.observability.report",
       description="Latency-breakdown summary of an exported trace "
                   "(observability/trace.py JSON).")
-  parser.add_argument("trace", help="path to the exported trace JSON")
+  parser.add_argument("trace", nargs="?", default=None,
+                      help="path to the exported trace JSON (optional "
+                           "with --follow)")
   parser.add_argument(
       "--metrics", default=None,
       help="registry metrics JSONL; prints the last serving/fleet/* "
            "rollup a multi-replica Router published")
+  parser.add_argument(
+      "--follow", default=None, metavar="METRICS_JSONL",
+      help="tail a live registry metrics JSONL: re-render the fleet "
+           "rollup and SLO status as records append (Ctrl-C to stop)")
+  parser.add_argument(
+      "--slo", default=None, metavar="SLO_EVENTS_JSONL",
+      help="SLO monitor events JSONL for --follow (default: a sibling "
+           "slo_events.jsonl of the followed file, when present)")
+  parser.add_argument("--interval", type=float, default=2.0,
+                      help="--follow poll interval in seconds")
+  parser.add_argument("--max-polls", type=int, default=0,
+                      help="stop --follow after N polls (0 = forever)")
   args = parser.parse_args(argv)
+  if args.follow is not None:
+    slo_path = args.slo
+    if slo_path is None:
+      sibling = os.path.join(os.path.dirname(os.path.abspath(
+          args.follow)), "slo_events.jsonl")
+      slo_path = sibling if os.path.exists(sibling) else None
+    follow(args.follow, slo_path=slo_path, interval_s=args.interval,
+           max_polls=args.max_polls)
+    return 0
+  if args.trace is None:
+    parser.error("a trace path is required unless --follow is given")
   print(format_report(load_events(args.trace)))
   if args.metrics is not None:
     fleet = fleet_rollup(args.metrics)

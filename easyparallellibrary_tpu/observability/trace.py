@@ -15,7 +15,15 @@ event substrate they all share:
 * **instants** — point events (request submit, first token, sentinel
   escalation, watchdog timeout);
 * **counter tracks** — numeric series (active slots, accepted draft
-  tokens) Perfetto renders as graphs.
+  tokens) Perfetto renders as graphs;
+* **flow events** — ``s``/``t``/``f`` phase triplets sharing one
+  ``id``, which Perfetto renders as arrows BETWEEN tracks.  The serving
+  stack threads one flow per request (``Request.flow_id``, minted at
+  router/scheduler submit) through dispatch → admission → every
+  migration → retirement, so a request that fails over between replicas
+  renders as a single connected arc across the replica tracks instead
+  of disconnected span fragments (docs/observability.md "Reading a
+  failover trace").
 
 Design constraints, in order:
 
@@ -237,6 +245,27 @@ class Tracer:
     if self.enabled:
       self._append("C", name, cat, self.now_us(), 0, {"value": value})
 
+  def flow(self, phase: str, flow_id: int,
+           name: str = "serving/request_flow", cat: str = "serving",
+           track: Optional[str] = None, ts: Optional[float] = None,
+           args: Optional[Dict[str, Any]] = None):
+    """Record one Perfetto flow event: ``phase`` is ``"s"`` (start),
+    ``"t"`` (step) or ``"f"`` (finish).  All events of one flow share
+    ``flow_id`` (and should share ``name``/``cat`` — viewers match
+    flows by category + id); each binds to the enclosing slice on its
+    track at ``ts``, and the viewer draws arrows start → steps →
+    finish.  The schema contract (:func:`validate_trace`): every
+    started flow must be finished, and steps/finishes must follow a
+    start."""
+    if not self.enabled:
+      return
+    if phase not in ("s", "t", "f"):
+      raise ValueError(f"flow phase must be 's', 't' or 'f': {phase!r}")
+    a = dict(args) if args else {}
+    a["id"] = int(flow_id)
+    self._append(phase, name, cat, self.now_us() if ts is None else ts,
+                 self.track(track), a)
+
   @contextlib.contextmanager
   def xla_trace(self, log_dir: str, name: str = "xla_trace"):
     """Bracket a ``jax.profiler`` device-trace capture with a host span,
@@ -280,6 +309,15 @@ class Tracer:
         ev["cat"] = cat
       if ph == "i":
         ev["s"] = "t"
+      if ph in ("s", "t", "f") and args is not None and "id" in args:
+        # Flow events carry their id top-level (Chrome trace format) and
+        # bind to the ENCLOSING slice ("bp": "e") so the arrow anchors
+        # on the request span the flow event was recorded inside.
+        args = dict(args)
+        ev["id"] = args.pop("id")
+        ev["bp"] = "e"
+        if not args:
+          args = None
       if args is not None:
         ev["args"] = args
       out.append(ev)
@@ -405,10 +443,14 @@ def validate_trace(trace: Union[str, Dict[str, Any], List[Dict[str, Any]]]
   or raises ``ValueError`` naming every problem.
 
   Checks: top-level shape, required keys per event, monotonically
-  non-decreasing ``ts``, and strict B/E pairing per (pid, tid) — every
-  E closes the innermost open B of the same name, nothing left open.
-  (``make trace-demo``'s quick test runs this over a real emitted
-  trace.)
+  non-decreasing ``ts``, strict B/E pairing per (pid, tid) — every
+  E closes the innermost open B of the same name, nothing left open —
+  and the flow schema: every ``s``/``t``/``f`` flow event carries an
+  ``id``, steps and finishes follow a start of the same id, no second
+  start while a flow is open, and every started flow TERMINATES with an
+  ``f`` (a failed-over request must reach retirement somewhere —
+  a dangling flow is a lost request).  (``make trace-demo``'s quick
+  test runs this over a real emitted trace.)
   """
   if isinstance(trace, str):
     with open(trace) as f:
@@ -424,6 +466,8 @@ def validate_trace(trace: Union[str, Dict[str, Any], List[Dict[str, Any]]]
   problems: List[str] = []
   last_ts: Optional[float] = None
   stacks: Dict[Tuple[Any, Any], List[str]] = {}
+  # Open flows: id -> index of the "s" event (for the error message).
+  flows: Dict[Any, int] = {}
   for i, ev in enumerate(events):
     if not isinstance(ev, dict):
       problems.append(f"event {i}: not an object")
@@ -444,6 +488,24 @@ def validate_trace(trace: Union[str, Dict[str, Any], List[Dict[str, Any]]]
           f"event {i} ({ph} {ev['name']!r}): ts {ts} < previous {last_ts} "
           f"(not monotonic)")
     last_ts = ts
+    if ph in ("s", "t", "f"):
+      if "id" not in ev:
+        problems.append(f"event {i} ({ph} {ev['name']!r}): flow event "
+                        f"missing 'id'")
+        continue
+      fid = ev["id"]
+      if ph == "s":
+        if fid in flows:
+          problems.append(
+              f"event {i}: flow {fid!r} started again while still open "
+              f"(previous start at event {flows[fid]})")
+        flows[fid] = i
+      elif fid not in flows:
+        problems.append(f"event {i}: flow {ph!r} phase for {fid!r} with "
+                        f"no open flow start")
+      elif ph == "f":
+        del flows[fid]
+      continue
     key = (ev["pid"], ev["tid"])
     stack = stacks.setdefault(key, [])
     if ph == "B":
@@ -462,6 +524,9 @@ def validate_trace(trace: Union[str, Dict[str, Any], List[Dict[str, Any]]]
   for key, stack in stacks.items():
     if stack:
       problems.append(f"unclosed span(s) {stack} on pid/tid {key}")
+  for fid, start_i in flows.items():
+    problems.append(f"flow {fid!r} (started at event {start_i}) never "
+                    f"terminated with an 'f' phase")
   if problems:
     raise ValueError("invalid trace:\n  " + "\n  ".join(problems))
   return events

@@ -42,6 +42,50 @@ import time
 from typing import Any, Dict, List, Optional
 
 
+# A full reservoir admits new samples with probability limit/count;
+# clamping the count at DECAY_HORIZON * limit floors that at 1/8, so
+# the sample tracks roughly the last 8*limit observations instead of
+# diluting toward the replica's whole life — an SLO percentile blind to
+# a fresh regression because the replica is old would defeat the
+# monitor (observability/slo.py) these samples feed.
+_RESERVOIR_DECAY_HORIZON = 8
+
+
+class _Reservoir:
+  """Deterministic sliding reservoir sample of an unbounded stream
+  (algorithm R with a fixed xorshift32 stream instead of ``random``,
+  and the admission count clamped — ``_RESERVOIR_DECAY_HORIZON``):
+  bounded memory for the life of a replica, recency-weighted enough
+  for live alerting, identical contents for identical input streams —
+  benchmark records and bit-exactness guards must not drift run to
+  run.  Until ``limit`` items have been seen the sample IS the stream,
+  so short windows (tests, small episodes) keep exact percentiles."""
+
+  __slots__ = ("limit", "items", "count", "_state")
+
+  def __init__(self, limit: int):
+    if limit < 1:
+      raise ValueError(f"reservoir limit must be >= 1: {limit}")
+    self.limit = limit
+    self.items: List[float] = []
+    self.count = 0
+    self._state = 0x9E3779B9
+
+  def add(self, x: float) -> None:
+    self.count += 1
+    if len(self.items) < self.limit:
+      self.items.append(float(x))
+      return
+    s = self._state
+    s ^= (s << 13) & 0xFFFFFFFF
+    s ^= s >> 17
+    s ^= (s << 5) & 0xFFFFFFFF
+    self._state = s
+    j = s % min(self.count, _RESERVOIR_DECAY_HORIZON * self.limit)
+    if j < self.limit:
+      self.items[j] = float(x)
+
+
 def percentile(values: List[float], q: float) -> float:
   """Nearest-rank percentile; 0.0 on empty input, the lone sample on a
   1-element window, and ``q`` clamped into [0, 100] — small windows are
@@ -74,15 +118,24 @@ class ServingStats:
   ``clock`` is injectable for deterministic tests.  All ``note_*`` hooks
   are cheap (dict insert / float math) and safe to call from the
   engine's host loop.  ``finished_limit`` bounds how many FINISHED
-  per-request traces are retained (oldest evicted first; latency
-  percentiles become a sliding window over the retained tail) — 0
-  keeps all, which on a long-running server grows host memory linearly
-  with requests served.  In-flight traces are never evicted.
+  per-request traces are retained (oldest evicted first) — 0 keeps all,
+  which on a long-running server grows host memory linearly with
+  requests served.  In-flight traces are never evicted.
+
+  Latency percentiles (TTFT / per-request mean ITL) are computed over
+  deterministic :class:`_Reservoir` samples capped at ``sample_limit``
+  per series — the raw-sample buffers are otherwise unbounded for the
+  life of a replica, and the fleet rollup (:func:`fleet_summary`)
+  extends every replica's buffer into a merged list on each rollup, so
+  both the per-replica memory AND the per-rollup merge cost must stay
+  O(sample_limit).  Below the cap the sample is exact.
   """
 
-  def __init__(self, clock=time.monotonic, finished_limit: int = 0):
+  def __init__(self, clock=time.monotonic, finished_limit: int = 0,
+               sample_limit: int = 1024):
     self._clock = clock
     self.finished_limit = finished_limit
+    self.sample_limit = sample_limit
     self.reset()
 
   def reset(self):
@@ -113,7 +166,13 @@ class ServingStats:
     self.degraded_transitions = 0
     self.degraded_level = 0
     self.watchdog_timeouts = 0
+    # Unexpected fused-step recompiles (observability/slo.py
+    # CompileSentinel): 0 is the contract; anything else is an incident.
+    self.recompiles = 0
     self.finish_reasons: Dict[str, int] = {}
+    # Bounded raw latency samples (class docstring).
+    self._ttft_res = _Reservoir(self.sample_limit)
+    self._itl_res = _Reservoir(self.sample_limit)
     # Paged KV block-pool gauges (last-seen; all 0 on a contiguous
     # engine): free/used blocks, internal fragmentation, and cumulative
     # preemptions (docs/serving.md "Paged KV cache").
@@ -148,12 +207,18 @@ class ServingStats:
   def note_first_token(self, uid: Any):
     tr = self._req.setdefault(uid, _RequestTrace(self._clock()))
     tr.first_token_at = self._clock()
+    self._ttft_res.add(tr.first_token_at - tr.submitted_at)
 
   def note_finished(self, uid: Any, new_tokens: int,
                     finish_reason: Optional[str] = None):
     tr = self._req.setdefault(uid, _RequestTrace(self._clock()))
     tr.finished_at = self._clock()
     tr.new_tokens = int(new_tokens)
+    if tr.first_token_at is not None and tr.new_tokens >= 2:
+      # Per-request mean inter-token latency; single-token requests
+      # have no inter-token gap.
+      self._itl_res.add((tr.finished_at - tr.first_token_at)
+                        / (tr.new_tokens - 1))
     self.finished_requests += 1
     self.generated_tokens += int(new_tokens)
     if finish_reason is not None:
@@ -208,6 +273,12 @@ class ServingStats:
   def note_watchdog_timeout(self):
     self.watchdog_timeouts += 1
 
+  def note_recompile(self, n: int = 1):
+    """Unexpected fused-step recompile(s) flagged by the compile
+    sentinel (observability/slo.py) — a first-class incident counter,
+    not a gauge."""
+    self.recompiles += int(n)
+
   # ----------------------------------------------------------------- step
 
   def note_step(self, active_slots: int, num_slots: int,
@@ -243,30 +314,23 @@ class ServingStats:
   # -------------------------------------------------------------- rollup
 
   def _ttfts(self) -> List[float]:
-    return [tr.first_token_at - tr.submitted_at
-            for tr in self._req.values()
-            if tr.first_token_at is not None]
+    return self._ttft_res.items
 
   def _itls(self) -> List[float]:
-    """Per-request mean inter-token latency (requests with >= 2 new
-    tokens; a single-token request has no inter-token gap)."""
-    out = []
-    for tr in self._req.values():
-      if (tr.finished_at is not None and tr.first_token_at is not None
-          and tr.new_tokens >= 2):
-        out.append((tr.finished_at - tr.first_token_at)
-                   / (tr.new_tokens - 1))
-    return out
+    return self._itl_res.items
 
   def ttft_samples(self) -> List[float]:
     """Raw per-request TTFT samples — the fleet rollup
     (:func:`fleet_summary`) merges RAW samples across replicas, because
-    percentiles of percentiles are not percentiles."""
-    return self._ttfts()
+    percentiles of percentiles are not percentiles.  Capped at
+    ``sample_limit`` by deterministic reservoir sampling (class
+    docstring), so the merge stays bounded no matter how long the
+    replica has served."""
+    return list(self._ttft_res.items)
 
   def itl_samples(self) -> List[float]:
     """Raw per-request mean-ITL samples (see :meth:`ttft_samples`)."""
-    return self._itls()
+    return list(self._itl_res.items)
 
   def publish(self, registry, step: int):
     """Publish :meth:`summary` under ``serving/*`` through a
@@ -320,6 +384,7 @@ class ServingStats:
         "degraded": float(self.degraded_transitions),
         "degraded_level": float(self.degraded_level),
         "watchdog_timeouts": float(self.watchdog_timeouts),
+        "recompiles": float(self.recompiles),
         "itl_ewma_s": float(self.itl_ewma_s),
     }
 
@@ -390,6 +455,7 @@ def fleet_summary(replica_stats: List["ServingStats"],
       "degraded": float(sum(s.degraded_transitions for s in stats)),
       "watchdog_timeouts": float(
           sum(s.watchdog_timeouts for s in stats)),
+      "recompiles": float(sum(s.recompiles for s in stats)),
   }
   if router_counters:
     out.update({k: float(v) for k, v in router_counters.items()})

@@ -58,6 +58,7 @@ speculative output keeps the sampling distribution, not the bitstream
 
 from __future__ import annotations
 
+import os
 import time
 import weakref
 from typing import Any, Dict, List, Optional
@@ -67,6 +68,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.observability import slo as slo_lib
 from easyparallellibrary_tpu.observability import trace as trace_lib
 from easyparallellibrary_tpu.serving import kv_cache as kv_lib
 from easyparallellibrary_tpu.serving._capabilities import (
@@ -76,6 +78,13 @@ from easyparallellibrary_tpu.serving.resilience import (
 from easyparallellibrary_tpu.serving.scheduler import (
     FCFSScheduler, FinishedRequest, Request, _slot_track)
 from easyparallellibrary_tpu.utils.logging import get_logger
+
+# Periodic ServingStats rollup cadence (engine steps): per-step records
+# carry only step-local gauges, so the TTFT/ITL percentile SLO rules
+# would otherwise only ever see a rollup at the END of a run() drive —
+# and never for router-driven replicas, which step() forever.  The
+# rollup is O(sample cap) thanks to the stats reservoirs.
+_STATS_PUBLISH_EVERY = 50
 
 
 def filtered_logits(logits, temperature, top_k, top_p):
@@ -196,14 +205,26 @@ class ContinuousBatchingEngine:
                num_blocks: Optional[int] = None,
                token_budget: Optional[int] = None,
                stats=None, metrics_writer=None, registry=None,
-               config=None):
+               config=None, track_prefix: Optional[str] = None):
     cfg = model.cfg
     root_config = config if config is not None else Env.get().config
     conf = root_config.serving
-    # Reconcile the ambient tracer with observability.* so a config-
-    # enabled run traces serving without any wiring at the call site.
+    # Reconcile the ambient tracer AND the ambient SLO monitor with
+    # observability.* so a config-enabled run traces and monitors
+    # serving without any wiring at the call site.
     trace_lib.ensure_configured(root_config)
+    self._slo = slo_lib.ensure_configured(root_config)
+    self._capture_xla = root_config.observability.slo.capture_xla
+    self._pending_xla_dir: Optional[str] = None
     check_servable(cfg)
+    # Perfetto track namespace for this engine's per-slot timelines
+    # (replicas pass serving/replica<i>; docs/observability.md).
+    self._track_prefix = track_prefix or "serving"
+    # This engine's twin label in breach payloads — the exact-match key
+    # that routes engine-attributed anomalies (recompile, watchdog)
+    # back to THIS engine and no other (e.g. the xla-capture listener
+    # on a shared ambient monitor must not arm every replica).
+    self._twin_label = f"{self._track_prefix}/fused_step"
     self.model = model
     self.params = params
     self.mesh = _resolve_mesh(mesh)
@@ -260,7 +281,8 @@ class ContinuousBatchingEngine:
         else conf.stop_token,
         spec_k=self.drafter.k if self.drafter is not None else 0,
         block_size=self.block_size, num_blocks=self.num_blocks,
-        token_budget=self.token_budget)
+        token_budget=self.token_budget,
+        track_prefix=self._track_prefix)
     res_conf = conf.resilience
     self._resilient = (resilience if resilience is not None
                        else res_conf.enabled)
@@ -302,15 +324,27 @@ class ContinuousBatchingEngine:
           max_requeues=res_conf.max_requeues)
       if res_conf.step_timeout_s > 0:
         from easyparallellibrary_tpu.runtime.resilience import StepWatchdog
-        # on_timeout binds the STATS object, not an engine method: the
-        # finalizer below pins the watchdog, so a watchdog->engine
-        # reference would pin the engine too and the finalizer could
-        # never fire.
+        # on_timeout binds the STATS and MONITOR objects, not an engine
+        # method: the finalizer below pins the watchdog, so a
+        # watchdog->engine reference would pin the engine too and the
+        # finalizer could never fire.  The monitor raises the hang as a
+        # first-class SLO breach (and deep-captures) from the watchdog's
+        # monitor thread — both objects are thread-safe.
         stats_obj = self.stats
+        slo_obj = self._slo
+        twin_label = self._twin_label
+
+        def _on_timeout(step, _stats=stats_obj, _slo=slo_obj,
+                        _twin=twin_label):
+          if _stats is not None:
+            _stats.note_watchdog_timeout()
+          if _slo is not None:
+            _slo.note_event("watchdog_timeout",
+                            {"engine_step": int(step), "twin": _twin},
+                            step=int(step))
+
         self._watchdog = StepWatchdog(
-            res_conf.step_timeout_s,
-            on_timeout=(None if stats_obj is None else
-                        lambda step: stats_obj.note_watchdog_timeout()),
+            res_conf.step_timeout_s, on_timeout=_on_timeout,
             knob="serving.resilience.step_timeout_s")
         # The monitor thread's target is a bound watchdog method, so the
         # thread pins the watchdog and never exits without close() — a
@@ -356,7 +390,8 @@ class ContinuousBatchingEngine:
     # Perfetto track name per slot (the scheduler's lifecycle spans and
     # the engine's per-step spans must land on the same track);
     # precomputed so the per-step tracing loop does no string work.
-    self._slot_tracks = [_slot_track(i) for i in range(self.num_slots)]
+    self._slot_tracks = [_slot_track(i, self._track_prefix)
+                         for i in range(self.num_slots)]
     self._steps = 0
     donate = conf.donate_cache if donate_cache is None else donate_cache
     if self.drafter is not None:
@@ -368,6 +403,28 @@ class ContinuousBatchingEngine:
       self._step_fn = self._build_paged_step(donate, self._resilient)
     else:
       self._step_fn = self._build_step(donate, self._resilient)
+    # Always-on compile sentinel (observability/slo.py): the compile-
+    # once contract moves from test-only to production — any post-
+    # warmup recompile of the fused step is detected the step it
+    # happens, attributed to the input signature, and raised as a
+    # first-class SLO breach + trace instant.  One host int compare per
+    # step; the thunk reads the LIVE attribute so chaos wrappers
+    # (testing/chaos._StepFnWrapper) that replace _step_fn stay
+    # transparent.
+    self._compile_sentinel = slo_lib.CompileSentinel(
+        self._twin_label,
+        lambda: self._step_fn._cache_size(),
+        on_recompile=[self._note_recompile])
+    if self._slo is not None:
+      # The monitor consumes this engine's registry records (it IS a
+      # registry sink) and merges this engine's scheduler/allocator
+      # summary into diagnostic bundles.  Both hooks hold the engine
+      # weakly/idempotently — the ambient monitor outlives engines.
+      if self.registry is not None:
+        self._slo.attach(self.registry)
+      self._slo.add_context_provider(self._capture_context)
+      if self._capture_xla:
+        self._slo.add_listener(self._arm_xla_capture, weak=True)
     if self.paged:
       layout = (f"paged: {self.num_blocks} x {self.block_size}-token "
                 f"blocks, token budget {self.token_budget}, "
@@ -429,6 +486,81 @@ class ContinuousBatchingEngine:
       tracer.counter("serving/degraded_level", new)
     if self.stats is not None:
       self.stats.note_degraded(new)
+
+  # -------------------------------------------------- observability hooks
+
+  def _describe_signature(self, plan) -> Dict[str, Any]:
+    """Shape/dtype signature of the step's host-side inputs at
+    recompile-detection time — built only on the (rare) recompile path
+    to attribute the event, never per healthy step."""
+    sig: Dict[str, Any] = {"twin": type(plan).__name__,
+                           "mesh": self.mesh is not None,
+                           "resilient": self._resilient,
+                           "paged": self.paged}
+    for name, v in vars(plan).items():
+      if hasattr(v, "shape"):
+        sig[name] = f"{v.dtype}{list(v.shape)}"
+    return sig
+
+  def _note_recompile(self, label: str, cache_size: int,
+                      new_compiles: int, signature) -> None:
+    """CompileSentinel subscriber: surface an unexpected fused-step
+    recompile as a trace instant, a stats counter, and a first-class
+    SLO breach (which also triggers deep capture when configured)."""
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.instant(
+          "serving/recompile", cat="serving", track="serving",
+          args={"twin": label, "cache_size": int(cache_size),
+                "new_compiles": int(new_compiles),
+                "signature": str(signature)[:512]})
+    if self.stats is not None:
+      self.stats.note_recompile(new_compiles)
+    if self._slo is not None:
+      self._slo.note_event(
+          "unexpected_recompile",
+          {"twin": label, "cache_size": int(cache_size),
+           "signature": str(signature)[:512]},
+          step=self._steps)
+
+  def _capture_context(self) -> Dict[str, Any]:
+    """Scheduler/allocator state summary merged into diagnostic bundles
+    (observability/slo.py DiagnosticCapture), keyed by this engine's
+    track prefix so replicas' summaries land side by side."""
+    sched = self.scheduler
+    ctx: Dict[str, Any] = {
+        "engine_steps": self._steps,
+        "queue_depth": sched.queue_depth,
+        "num_active": sched.num_active,
+        "num_slots": self.num_slots,
+        "paged": self.paged,
+        "recompiles": self._compile_sentinel.recompiles,
+        "active_uids": [str(s.req.uid)
+                        for s in sched.active.values()][:32],
+    }
+    if self._admission is not None:
+      ctx["degraded_level"] = self._admission.level
+      ctx["shed_total"] = self._admission.shed_total
+    if self._bad_policy is not None:
+      ctx.update(self._bad_policy.counters())
+    if self.paged:
+      ctx.update(kv_blocks_free=sched.kv_blocks_free,
+                 kv_blocks_used=sched.kv_blocks_used,
+                 kv_fragmentation=sched.kv_fragmentation,
+                 preemptions=sched.preemptions,
+                 proactive_preemptions=sched.proactive_preemptions)
+    return {self._track_prefix: ctx}
+
+  def _arm_xla_capture(self, rule: str, payload: Dict[str, Any]) -> None:
+    """Breach listener (observability.slo.capture_xla): arm a
+    jax.profiler device capture around the NEXT fused step, written
+    under the breach's diagnostic bundle.  Only for breaches the
+    payload attributes to THIS engine's twin — the ambient monitor is
+    shared, and a fleet-level breach arming a heavy device capture on
+    every healthy replica at once would be the anomaly."""
+    bundle = payload.get("bundle")
+    if bundle and payload.get("twin") == self._twin_label:
+      self._pending_xla_dir = os.path.join(bundle, "xla")
 
   # ----------------------------------------------------------- device step
 
@@ -662,6 +794,11 @@ class ContinuousBatchingEngine:
             args={"uid": str(request.uid),
                   "queue_depth": int(self.scheduler.queue_depth),
                   "level": DEGRADE_LEVELS[self._admission.level]})
+        if request.flow_id is not None:
+          # A router-minted flow must terminate even on a shed — the
+          # rejection IS this request's resolution.
+          tracer.flow("f", request.flow_id, track="serving/requests",
+                      args={"uid": str(request.uid), "reason": "shed"})
       get_logger().warning(
           "shedding request %r at submit (queue %d/%d, level %s)",
           request.uid, self.scheduler.queue_depth,
@@ -952,6 +1089,14 @@ class ContinuousBatchingEngine:
     t0 = time.monotonic()
     if self._watchdog is not None:
       self._watchdog.arm(self._steps)
+    xla_ctx = None
+    if self._pending_xla_dir is not None:
+      # Deep capture armed a device profile for the step AFTER the
+      # breach (observability.slo.capture_xla): the anomaly's immediate
+      # aftermath is the timeline worth keeping.
+      xla_dir, self._pending_xla_dir = self._pending_xla_dir, None
+      xla_ctx = tracer.xla_trace(xla_dir)
+      xla_ctx.__enter__()
     drafted = accepted = 0
     slot_ok = None
     try:
@@ -1038,11 +1183,17 @@ class ContinuousBatchingEngine:
     finally:
       if self._watchdog is not None:
         self._watchdog.disarm()
+      if xla_ctx is not None:
+        xla_ctx.__exit__(None, None, None)
     if slot_ok is not None:
       self._handle_bad_slots(plan, slot_ok)
       # Quarantine retirements ("failed") belong to this iteration.
       finished.extend(self.scheduler.take_finished())
     self._steps += 1
+    # Compile sentinel: one host int compare per step; the signature
+    # thunk only runs on the (rare) recompile path.
+    self._compile_sentinel.check(
+        signature_fn=lambda: self._describe_signature(plan))
     dt = time.monotonic() - t0
     # Throughput/ITL samples count COMMITTED tokens only: a bad slot's
     # planned tokens never committed and the identical work is re-fed
@@ -1078,7 +1229,8 @@ class ContinuousBatchingEngine:
                                self.scheduler.kv_fragmentation,
                                self.scheduler.preemptions,
                                self.scheduler.proactive_preemptions)
-    if self.metrics_writer is not None or self.registry is not None:
+    if (self.metrics_writer is not None or self.registry is not None
+        or self._slo is not None):
       record = {
           "active_slots": plan.active_slots,
           "slot_occupancy": plan.active_slots / self.num_slots,
@@ -1109,7 +1261,26 @@ class ContinuousBatchingEngine:
         # Legacy flat keys (pre-registry callers depend on them).
         self.metrics_writer.write(self._steps, record)
       if self.registry is not None:
+        # The SLO monitor rides the registry as a sink (attach above) —
+        # publishing once feeds the sinks AND the rules.
         self.registry.publish(self._steps, record, "serving")
+      elif self._slo is not None:
+        # Registry-less engine: feed the monitor the same namespaced
+        # record directly (host scalars only — no added syncs).
+        self._slo.observe(self._steps,
+                          {f"serving/{k}": v for k, v in record.items()})
+    if (self.stats is not None
+        and self._steps % _STATS_PUBLISH_EVERY == 0
+        and (self.registry is not None or self._slo is not None)):
+      # Periodic percentile rollup so latency SLO rules stay LIVE on a
+      # long-serving engine (_STATS_PUBLISH_EVERY above).
+      if self.registry is not None:
+        self.stats.publish(self.registry, self._steps)
+      else:
+        self._slo.observe(
+            self._steps,
+            {f"serving/{k}": v
+             for k, v in self.stats.summary().items()})
     return finished
 
   def run(self, max_steps: Optional[int] = None

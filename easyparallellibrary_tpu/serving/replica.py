@@ -87,6 +87,10 @@ class EngineReplica:
       stats = ServingStats()
     if stats is not None:
       engine_kwargs["stats"] = stats
+    # Per-replica Perfetto tracks (serving/replica<i>/slot N) so a
+    # failed-over request's flow arc visibly crosses replica tracks
+    # instead of two replicas' slot 0 sharing one row.
+    engine_kwargs.setdefault("track_prefix", f"serving/replica{index}")
     self.engine = ContinuousBatchingEngine(
         model, params, mesh=mesh, config=config,
         registry=(_ReplicaRegistry(registry, index)

@@ -53,6 +53,7 @@ acceptance harness.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 import zlib
 from collections import OrderedDict
@@ -61,12 +62,13 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.observability import slo as slo_lib
 from easyparallellibrary_tpu.observability import trace as trace_lib
 from easyparallellibrary_tpu.profiler.serving import fleet_summary
 from easyparallellibrary_tpu.serving.replica import EngineReplica
 from easyparallellibrary_tpu.serving.resilience import ReplicaHealth
 from easyparallellibrary_tpu.serving.scheduler import (
-    FinishedRequest, Request)
+    FinishedRequest, Request, next_flow_id)
 from easyparallellibrary_tpu.utils.logging import get_logger
 
 # Prompt tokens hashed for prefix-affinity routing: long enough to
@@ -105,7 +107,14 @@ class Router:
     rconf = root_config.serving.router
     self._drain_timeout_s = rconf.drain_timeout_s
     self._affinity_enabled = rconf.affinity
+    self._heartbeat_s = rconf.heartbeat_s
     self.clock = clock
+    # Ambient SLO monitor (observability/slo.py): the router feeds it
+    # the live fleet rollup — every heartbeat interval, and immediately
+    # on failover — so TTFT/ITL/shed/availability rules see the fleet
+    # as one deployment, not N replica streams after the fact.
+    self._slo = slo_lib.ensure_configured(root_config)
+    self._last_rollup = clock()
     if replicas is not None:
       self.replicas: List[EngineReplica] = list(replicas)
     else:
@@ -126,6 +135,8 @@ class Router:
             on_transition=self._make_health_hook(i))
         for i in range(len(self.replicas))]
     self.registry = registry
+    if self._slo is not None and registry is not None:
+      self._slo.attach(registry)
     # Fleet-wide resolution record: uid -> FinishedRequest, exactly one
     # entry per resolved request regardless of which replica (or the
     # router itself) resolved it.
@@ -227,8 +238,17 @@ class Router:
     control.  Either way the shed record lands in :attr:`finished` with
     reason ``"shed"``, exactly once."""
     prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+    # The trace-context id is minted HERE — the earliest point the
+    # request touches the fleet — so its flow arc starts at routing and
+    # stays one connected thread through dispatch, admission, any
+    # failover, and retirement (docs/observability.md).
+    if request.flow_id is None:
+      request = dataclasses.replace(request, flow_id=next_flow_id())
     idx, reason = self._choose(prompt)
     tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.flow("s", request.flow_id, track="serving/requests",
+                  args={"uid": str(request.uid)})
     if idx is None:
       self.router_shed += 1
       self.finished[request.uid] = FinishedRequest(
@@ -239,6 +259,8 @@ class Router:
             "serving/route", cat="serving", track="serving/requests",
             args={"uid": str(request.uid), "replica": -1,
                   "reason": "no_replica"})
+        tracer.flow("f", request.flow_id, track="serving/requests",
+                    args={"uid": str(request.uid), "reason": "shed"})
       get_logger().warning(
           "router shedding request %r: no routable replica (states %s)",
           request.uid, self.states())
@@ -278,6 +300,13 @@ class Router:
                 generated]),
             new_tokens=int(generated.size), finish_reason="cancelled")
         self._note_finished(-1, fin)
+        tracer = trace_lib.get_tracer()
+        flow_id = snap["request"].get("flow_id")
+        if tracer.enabled and flow_id is not None:
+          # A parked request's cancellation is its resolution — the
+          # flow terminates here, not on any replica track.
+          tracer.flow("f", flow_id, track="serving/requests",
+                      args={"uid": str(uid), "reason": "cancelled"})
         return True
     idx = self.placement.get(uid)
     if idx is not None:
@@ -336,7 +365,26 @@ class Router:
     # age is zero and this is a no-op for them.
     self._reap(now)
     self.steps += 1
+    # Live fleet rollup on the heartbeat cadence: the registry's sinks
+    # (report.py --follow tails the JSONL) and the SLO monitor's rules
+    # both see the fleet mid-run, not just at drain.  Raw-sample
+    # percentile merging is bounded by the stats' reservoirs
+    # (profiler/serving.py), so this stays O(replicas * sample cap).
+    if (self.registry is not None or self._slo is not None) and \
+        self.clock() - self._last_rollup >= self._heartbeat_s:
+      self._publish_rollup()
     return out
+
+  def _publish_rollup(self) -> None:
+    self._last_rollup = self.clock()
+    rollup = self.fleet_summary()
+    if self.registry is not None:
+      # The SLO monitor rides the registry as a sink (attach at init).
+      self.registry.publish(self.steps, rollup, "serving/fleet")
+    elif self._slo is not None:
+      self._slo.observe(self.steps,
+                        {f"serving/fleet/{k}": v
+                         for k, v in rollup.items()})
 
   def _reap(self, now: float) -> None:
     """Fail over any down replica still holding requests.  Idempotent —
@@ -373,8 +421,8 @@ class Router:
             "replica (states %s); returning — rejoin a replica to "
             "resume", len(self._parked), self.states())
         break
-    if self.registry is not None:
-      self.publish(self.registry, self.steps)
+    if self.registry is not None or self._slo is not None:
+      self._publish_rollup()
     return out
 
   @property
@@ -435,11 +483,20 @@ class Router:
           "failover of replica %d found NO survivor: parking %d "
           "request(s) until a replica rejoins", index, len(snaps))
       self._parked.extend(snaps)
+      self._note_incident()
       return
     self._place_snapshots(snaps, targets)
     get_logger().warning(
         "replica %d failed over: %d request(s) resumed on replica(s) %s "
         "via prefix replay", index, len(snaps), targets)
+    self._note_incident()
+
+  def _note_incident(self) -> None:
+    """Publish the fleet rollup IMMEDIATELY (not on the heartbeat
+    cadence): a failover must open its SLO breach window — and land in
+    the tailed metrics log — at the kill, not up to a heartbeat later."""
+    if self.registry is not None or self._slo is not None:
+      self._publish_rollup()
 
   def _flush_parked(self) -> None:
     if not self._parked:

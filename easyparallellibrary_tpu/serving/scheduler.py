@@ -39,6 +39,7 @@ Responsibilities (and nothing else — device work lives in engine.py):
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import time
 import zlib
 from collections import deque
@@ -53,10 +54,27 @@ from easyparallellibrary_tpu.serving._capabilities import (
 from easyparallellibrary_tpu.utils.logging import get_logger
 
 
-def _slot_track(slot: int) -> str:
+def _slot_track(slot: int, prefix: str = "serving") -> str:
   """Perfetto track name for one KV-cache slot — every request served by
-  this slot renders its lifecycle span here (docs/observability.md)."""
-  return f"serving/slot {slot}"
+  this slot renders its lifecycle span here (docs/observability.md).
+  Replicas pass their own prefix (``serving/replica<i>``) so the fleet's
+  tracks stay distinct and a failed-over request's flow arc visibly
+  crosses replica tracks."""
+  return f"{prefix}/slot {slot}"
+
+
+# Flow-context ids (Perfetto flow events; docs/observability.md
+# "Request-flow correlation"): one id per request lifetime, minted at
+# the FIRST submit the request reaches — the router when there is one,
+# the scheduler otherwise — and carried through snapshot/restore so a
+# failed-over request keeps its flow across replicas.  Process-unique
+# is all a trace needs; minting is unconditional (a plain int) so
+# enabling the tracer mid-run never sees id-less requests.
+_FLOW_IDS = itertools.count(1)
+
+
+def next_flow_id() -> int:
+  return next(_FLOW_IDS)
 
 
 def _request_key(req: "Request") -> np.ndarray:
@@ -95,6 +113,11 @@ class Request:
   produced within the budget.  Both are 0 = off.  ``priority`` is
   ``"throughput"`` (FCFS) or ``"latency"`` (admitted ahead of queued
   throughput-class requests).
+
+  ``flow_id`` is the request's trace-context id (Perfetto flow events;
+  docs/observability.md "Request-flow correlation") — minted
+  automatically at the first submit (router or scheduler) and carried
+  through snapshot/restore, so callers never set it.
   """
   uid: Any
   prompt: np.ndarray
@@ -108,6 +131,7 @@ class Request:
   deadline_s: float = 0.0
   ttft_budget_s: float = 0.0
   priority: str = "throughput"
+  flow_id: Optional[int] = None
 
   def snapshot(self) -> Dict[str, Any]:
     """JSON-serializable snapshot of the request spec (the immutable
@@ -131,6 +155,7 @@ class Request:
         "deadline_s": float(self.deadline_s),
         "ttft_budget_s": float(self.ttft_budget_s),
         "priority": self.priority,
+        "flow_id": None if self.flow_id is None else int(self.flow_id),
     }
 
   @classmethod
@@ -303,7 +328,7 @@ class FCFSScheduler:
                max_batch: int = 0, stop_token: int = -1,
                spec_k: int = 0, clock: Callable[[], float] = time.monotonic,
                block_size: int = 0, num_blocks: int = 0,
-               token_budget: int = 0):
+               token_budget: int = 0, track_prefix: str = "serving"):
     from easyparallellibrary_tpu.serving.kv_cache import (
         BlockAllocator, SlotAllocator)
     if prefill_chunk < 1:
@@ -362,6 +387,9 @@ class FCFSScheduler:
     self.max_batch = max_batch if max_batch > 0 else num_slots
     self.default_stop_token = stop_token
     self.clock = clock
+    # Slot-track namespace for this scheduler's lifecycle spans
+    # (replicas pass serving/replica<i> so fleet tracks stay distinct).
+    self.track_prefix = track_prefix
     self.allocator = SlotAllocator(num_slots)
     self.pending: Deque[_Pending] = deque()
     # Count of queued latency-class entries, maintained at every
@@ -423,6 +451,11 @@ class FCFSScheduler:
     req = dataclasses.replace(req, prompt=prompt)
     if req.stop_token < 0 and self.default_stop_token >= 0:
       req = dataclasses.replace(req, stop_token=self.default_stop_token)
+    # Flow-context id: minted here unless an upstream router already
+    # did (its id wins — the flow must span the WHOLE dispatch arc).
+    minted = req.flow_id is None
+    if minted:
+      req = dataclasses.replace(req, flow_id=next_flow_id())
     self.pending.append(_Pending(req, self.clock()))
     self._latency_pending += req.priority == "latency"
     self._deadline_pending += self._has_deadline(req)
@@ -432,6 +465,10 @@ class FCFSScheduler:
           "serving/submit", cat="serving", track="serving/requests",
           args={"uid": str(req.uid), "prompt_tokens": int(prompt.size),
                 "max_new_tokens": int(req.max_new_tokens)})
+      # The minter starts the flow; a router-minted flow already has
+      # its "s" — this submit is one step of its arc.
+      tracer.flow("s" if minted else "t", req.flow_id,
+                  track="serving/requests", args={"uid": str(req.uid)})
 
   @property
   def has_work(self) -> bool:
@@ -469,6 +506,11 @@ class FCFSScheduler:
       tracer.instant(
           f"serving/{reason}", cat="serving", track="serving/requests",
           args={"uid": str(entry.req.uid), "where": "queue"})
+      if entry.req.flow_id is not None:
+        # Queue-side retirement terminates the flow too — every started
+        # flow must reach an "f" (validate_trace).
+        tracer.flow("f", entry.req.flow_id, track="serving/requests",
+                    args={"uid": str(entry.req.uid), "reason": reason})
     self._finished_buffer.append(fin)
     for fn in self.on_finish:
       fn(fin)
@@ -556,9 +598,15 @@ class FCFSScheduler:
     state.bad_streak = 0
     tracer = trace_lib.get_tracer()
     if tracer.enabled:
+      if state.req.flow_id is not None:
+        # Flow step INSIDE the closing span, so the arc anchors on this
+        # occupancy before jumping to the request's next slot.
+        tracer.flow("t", state.req.flow_id,
+                    track=_slot_track(slot, self.track_prefix),
+                    args={"uid": str(state.req.uid), "reason": reason})
       tracer.end(
           f"request {state.req.uid}", cat="serving.request",
-          track=_slot_track(slot),
+          track=_slot_track(slot, self.track_prefix),
           args={"finish_reason": "requeued",
                 "new_tokens": int(len(state.generated))})
       tracer.instant(
@@ -632,6 +680,9 @@ class FCFSScheduler:
     Returns the restored uid."""
     req = Request.restore(snap["request"])
     req = dataclasses.replace(req, prompt=self.validate(req))
+    restored_flow = req.flow_id is not None
+    if not restored_flow:  # pre-flow snapshot: start a fresh flow here
+      req = dataclasses.replace(req, flow_id=next_flow_id())
     submitted_at = float(snap["submitted_at"])
     generated = [int(t) for t in snap.get("generated", ())]
     carried = None
@@ -659,6 +710,9 @@ class FCFSScheduler:
           "serving/restore", cat="serving", track="serving/requests",
           args={"uid": str(req.uid),
                 "committed_prefix": int(len(req.prompt) + len(generated))})
+      tracer.flow("t" if restored_flow else "s", req.flow_id,
+                  track="serving/requests",
+                  args={"uid": str(req.uid), "reason": "restored"})
     return req.uid
 
   def evacuate(self) -> List[Dict[str, Any]]:
@@ -679,9 +733,14 @@ class FCFSScheduler:
       self._release_blocks(slot)
       self._deadline_active -= self._has_deadline(state.req)
       if tracer.enabled:
+        if state.req.flow_id is not None:
+          tracer.flow("t", state.req.flow_id,
+                      track=_slot_track(slot, self.track_prefix),
+                      args={"uid": str(state.req.uid),
+                            "reason": "migrated"})
         tracer.end(
             f"request {state.req.uid}", cat="serving.request",
-            track=_slot_track(slot),
+            track=_slot_track(slot, self.track_prefix),
             args={"finish_reason": "migrated",
                   "new_tokens": int(len(state.generated))})
     self.pending.clear()
@@ -764,7 +823,14 @@ class FCFSScheduler:
         if state.requeues:
           args["requeues"] = int(state.requeues)
         tracer.begin(f"request {req.uid}", cat="serving.request",
-                     track=_slot_track(slot), args=args)
+                     track=_slot_track(slot, self.track_prefix),
+                     args=args)
+        if req.flow_id is not None:
+          # Flow step just inside the freshly opened span: the arc
+          # lands on this slot's track for this occupancy.
+          tracer.flow("t", req.flow_id,
+                      track=_slot_track(slot, self.track_prefix),
+                      args={"uid": str(req.uid)})
       if state.requeues == 0:
         for fn in self.on_admit:
           fn(req.uid)
@@ -1137,9 +1203,13 @@ class FCFSScheduler:
     self._deadline_active -= self._has_deadline(state.req)
     tracer = trace_lib.get_tracer()
     if tracer.enabled:
+      if state.req.flow_id is not None:
+        tracer.flow("f", state.req.flow_id,
+                    track=_slot_track(slot, self.track_prefix),
+                    args={"uid": str(state.req.uid), "reason": reason})
       tracer.end(
           f"request {state.req.uid}", cat="serving.request",
-          track=_slot_track(slot),
+          track=_slot_track(slot, self.track_prefix),
           args={"finish_reason": reason,
                 "new_tokens": int(len(state.generated))})
     fin = FinishedRequest(
@@ -1200,7 +1270,8 @@ class FCFSScheduler:
           if tracer.enabled:
             tracer.instant(
                 "serving/first_token", cat="serving",
-                track=_slot_track(slot), args={"uid": str(req.uid)})
+                track=_slot_track(slot, self.track_prefix),
+                args={"uid": str(req.uid)})
           for fn in self.on_first_token:
             fn(req.uid)
         # A requeued replay commits this sample too: the last prefix

@@ -1,0 +1,561 @@
+"""Fleet-grade observability (ISSUE 9): request-flow correlation, SLO
+monitors, compile sentinel, anomaly-triggered deep capture.
+
+The acceptance contract: a router kill episode exports a schema-valid
+trace in which every migrated request is ONE connected flow (router
+submit → first replica → failover → survivor retire),
+``slo_events.jsonl`` records the breach window, and a diagnostic bundle
+exists for the kill — while the fault-free guard shows bit-exact
+streams, fused-step compile count 1, and the compile sentinel silent,
+with the full layer enabled.  The quick trio below pins exactly that;
+the units cover the rule engine, burn-rate windows, sentinel watermark,
+capture rate limiting, reservoir determinism, and ``report --follow``.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.models import GPT, GPTConfig
+from easyparallellibrary_tpu.models.gpt import generate
+from easyparallellibrary_tpu.observability import report
+from easyparallellibrary_tpu.observability import slo as slo_lib
+from easyparallellibrary_tpu.observability import trace as trace_lib
+from easyparallellibrary_tpu.observability.registry import MetricRegistry
+from easyparallellibrary_tpu.observability.slo import (
+    BurnRateRule, CompileSentinel, DiagnosticCapture, SLOMonitor,
+    SLORule)
+from easyparallellibrary_tpu.observability.trace import validate_trace
+from easyparallellibrary_tpu.profiler.serving import (
+    ServingStats, _Reservoir)
+from easyparallellibrary_tpu.serving import (
+    ContinuousBatchingEngine, Request, Router)
+from easyparallellibrary_tpu.testing import chaos
+
+TINY = GPTConfig(vocab_size=64, num_layers=2, num_heads=4, d_model=32,
+                 d_ff=64, max_seq_len=32, dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _drop_ambient_observability():
+  """Ambient tracer/monitor outlive the per-test Env reset; drop both
+  so later tests (and test files) start clean."""
+  yield
+  trace_lib.reset()
+  slo_lib.reset()
+
+
+def _model_and_params(cfg=TINY, seed=0):
+  model = GPT(cfg)
+  params = model.init(jax.random.PRNGKey(seed),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+  return model, params
+
+
+def _oracle(model, params, prompt, max_new):
+  return np.asarray(
+      generate(model, params, jnp.asarray(prompt)[None], max_new))[0]
+
+
+def _track_names(events):
+  """tid -> thread-name from the export's metadata events."""
+  return {e["tid"]: e["args"]["name"] for e in events
+          if e.get("ph") == "M" and e.get("name") == "thread_name"}
+
+
+# ---------------------------------------------------- quick acceptance
+
+
+@pytest.mark.quick
+def test_failover_flow_connected_breach_logged_bundle_captured(tmp_path):
+  """THE acceptance episode: kill one of two replicas mid-decode with
+  the full observability layer on.  Every request finishes bit-exact;
+  each MIGRATED request's flow renders as one connected arc touching
+  BOTH replicas' tracks; the trace passes the flow-aware validator;
+  slo_events.jsonl records the replica_down breach window; a diagnostic
+  bundle exists for the kill; and the compile sentinel stays silent
+  through the whole join/leave/failover/rejoin episode (survivor's
+  fused step still compiled once)."""
+  events_path = str(tmp_path / "slo_events.jsonl")
+  capture_dir = str(tmp_path / "diag")
+  trace_path = str(tmp_path / "trace.json")
+  epl.init(epl.Config({"observability": {
+      "enabled": True,
+      "slo": {"enabled": True, "events_path": events_path,
+              "capture_dir": capture_dir,
+              "capture_min_interval_s": 0.0}}}))
+  tracer = trace_lib.ensure_configured()
+  model, params = _model_and_params()
+  r = np.random.RandomState(8)
+  prompts = [r.randint(0, 64, (n,)).astype(np.int32)
+             for n in (5, 3, 9, 2)]
+  registry = MetricRegistry()
+  router = Router(model, params, num_replicas=2, num_slots=2,
+                  prefill_chunk=4, registry=registry)
+  killer = chaos.ReplicaKiller(router.replicas[0].engine,
+                               kill_calls=(3,))
+  for i, p in enumerate(prompts):
+    assert router.submit(Request(uid=i, prompt=p, max_new_tokens=6))
+  assert {router.placement[i] for i in range(4)} == {0, 1}
+  out = router.run()
+  assert killer.kills == 1 and router.failovers == 1
+
+  # Join/leave continued after the failover; now rejoin the corpse warm
+  # (the breaker is force-overridden) and serve one more request — the
+  # compile sentinel must stay silent across the WHOLE episode.
+  assert router.rejoin(0, force=True)
+  assert router.submit(Request(uid="post", prompt=prompts[0],
+                               max_new_tokens=4))
+  out.update(router.run())
+  for rep in router.replicas:
+    assert rep.engine._compile_sentinel.recompiles == 0
+    assert rep.stats.recompiles == 0
+  assert router.replicas[1].engine._step_fn._cache_size() == 1, \
+      "failover/rejoin must not recompile the survivor's fused step"
+
+  # Streams bit-exact vs the single-engine oracle, nothing lost.
+  for i, p in enumerate(prompts):
+    np.testing.assert_array_equal(out[i], _oracle(model, params, p, 6),
+                                  err_msg=f"req {i}")
+
+  # Schema-valid export, INCLUDING the flow schema (every flow ends).
+  assert tracer.export(trace_path)
+  events = validate_trace(trace_path)
+  tracks = _track_names(events)
+
+  # One flow per request: s at the router, f at retirement.
+  flows = {}
+  for ev in events:
+    if ev.get("ph") in ("s", "t", "f"):
+      flows.setdefault(ev["id"], []).append(ev)
+  assert flows, "no request-flow events in the trace"
+  for fid, evs in flows.items():
+    phases = [e["ph"] for e in evs]
+    assert phases[0] == "s" and phases[-1] == "f", (fid, phases)
+
+  # Migrated requests: their flow arc must touch BOTH replicas' slot
+  # tracks — router submit -> replica0 slot -> failover -> replica1.
+  spans, _ = report.pair_spans(events)
+  migrated_uids = {s["args"]["uid"] for s in spans
+                   if s["args"].get("finish_reason") == "migrated"}
+  assert migrated_uids, "the kill should have migrated requests"
+  uid_flows = {}
+  for ev in events:
+    if ev.get("ph") == "s" and "args" in ev and "uid" in ev["args"]:
+      uid_flows[ev["args"]["uid"]] = ev["id"]
+  for uid in migrated_uids:
+    evs = flows[uid_flows[uid]]
+    names = {tracks.get(e["tid"], "") for e in evs}
+    assert any(n.startswith("serving/replica0/slot") for n in names), \
+        (uid, names)
+    assert any(n.startswith("serving/replica1/slot") for n in names), \
+        (uid, names)
+
+  # The SLO monitor recorded the breach window in the machine-readable
+  # log (the replica_down rule over the fleet rollup published AT the
+  # failover, not a heartbeat later).
+  slo_events = [json.loads(l) for l in open(events_path)]
+  breaches = [e for e in slo_events if e["event"] == "breach"
+              and e["rule"] == "replica_down"]
+  assert breaches and breaches[0]["value"] == 1.0
+  # The warm rejoin closed the window.
+  recoveries = [e for e in slo_events if e["event"] == "recover"
+                and e["rule"] == "replica_down"]
+  assert recoveries, "rejoin should have recorded the recovery"
+
+  # A diagnostic bundle exists for the kill: staged+renamed (no .tmp),
+  # carrying the trace tail, registry snapshot and engine summaries.
+  bundles = sorted(os.listdir(capture_dir))
+  assert bundles and not any(b.endswith(".tmp") for b in bundles)
+  bundle = os.path.join(capture_dir, bundles[0])
+  contents = set(os.listdir(bundle))
+  assert {"meta.json", "trace.json", "registry.json"} <= contents
+  meta = json.load(open(os.path.join(bundle, "meta.json")))
+  assert meta["reason"] == "replica_down"
+  if "state.json" in contents:
+    state = json.load(open(os.path.join(bundle, "state.json")))
+    assert any(k.startswith("serving/replica") for k in state)
+  router.close()
+
+
+@pytest.mark.quick
+def test_slo_monitor_fault_free_bit_exact_zero_recompile(tmp_path):
+  """Fault-free guard: serving with the FULL layer enabled (tracer +
+  SLO monitor + registry + compile sentinel + deep capture armed) is
+  bit-identical to the bare baseline, with the fused step still
+  compiled once and zero sentinel flags — monitoring never changes what
+  it monitors."""
+  cfg = GPTConfig(vocab_size=64, num_layers=1, num_heads=4, d_model=32,
+                  d_ff=64, max_seq_len=32, dtype=jnp.float32)
+  model, params = _model_and_params(cfg)
+  r = np.random.RandomState(5)
+  prompts = [r.randint(0, 64, (n,)).astype(np.int32)
+             for n in (5, 3, 6, 2)]
+
+  def drive(eng):
+    for i in range(2):
+      assert eng.submit(Request(uid=i, prompt=prompts[i],
+                                max_new_tokens=5 + i))
+    out = {}
+    for _ in range(2):
+      for fin in eng.step():
+        out[fin.uid] = fin.tokens
+    for i in range(2, 4):
+      assert eng.submit(Request(uid=i, prompt=prompts[i],
+                                max_new_tokens=5 + i))
+    out.update(eng.run())
+    return out
+
+  epl.init()
+  base = drive(ContinuousBatchingEngine(model, params, num_slots=2,
+                                        prefill_chunk=4))
+  epl.init(epl.Config({"observability": {
+      "enabled": True,
+      "slo": {"enabled": True, "ttft_p99_s": 30.0, "itl_p99_s": 30.0,
+              "shed_objective": 0.99,
+              "events_path": str(tmp_path / "slo.jsonl"),
+              "capture_dir": str(tmp_path / "diag")}}}))
+  eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                 prefill_chunk=4, stats=ServingStats(),
+                                 registry=MetricRegistry())
+  monitored = drive(eng)
+  monitor = slo_lib.get_monitor()
+  assert monitor is not None
+  assert eng._step_fn._cache_size() == 1
+  assert eng._compile_sentinel.recompiles == 0
+  # The monitor really evaluated this run's records (per-step via the
+  # registry sink, percentile rollups at run() end) — and a healthy
+  # fault-free run breached nothing.
+  assert any(key.startswith(("ttft_p99", "itl_p99"))
+             for key in monitor.status()), monitor.status()
+  assert monitor.breaches == 0
+  assert sorted(base) == sorted(monitored)
+  for i in base:
+    np.testing.assert_array_equal(monitored[i], base[i],
+                                  err_msg=f"req {i}")
+
+
+def test_engine_publishes_percentile_rollups_mid_run():
+  """Review fix: per-step records carry only step-local gauges, so the
+  TTFT/ITL SLO rules need the PERIODIC stats rollup — published every
+  50 engine steps — to stay live on an engine driven by step() forever
+  (a router replica never calls run(), whose end-of-drive publish was
+  previously the only rollup)."""
+  class _Sink:
+    def __init__(self):
+      self.records = []
+
+    def write(self, step, metrics):
+      self.records.append(dict(metrics))
+
+    def flush(self):
+      pass
+
+    def close(self):
+      pass
+
+  cfg = GPTConfig(vocab_size=64, num_layers=1, num_heads=4, d_model=32,
+                  d_ff=64, max_seq_len=128, dtype=jnp.float32)
+  model, params = _model_and_params(cfg)
+  epl.init(epl.Config({"observability": {"slo": {
+      "enabled": True, "ttft_p99_s": 60.0}}}))
+  sink = _Sink()
+  eng = ContinuousBatchingEngine(model, params, num_slots=1,
+                                 prefill_chunk=4, stats=ServingStats(),
+                                 registry=MetricRegistry(sink))
+  eng.submit(Request(uid="a", prompt=np.arange(4, dtype=np.int32),
+                     max_new_tokens=70))
+  while eng.has_work:   # step() directly — run()'s end publish never fires
+    eng.step()
+  rollups = [r for r in sink.records if "serving/ttft_p99_s" in r]
+  assert rollups, "no mid-run percentile rollup reached the registry"
+  monitor = slo_lib.get_monitor()
+  assert any(key.startswith("ttft_p99") for key in monitor.status())
+  assert monitor.breaches == 0
+
+
+# ------------------------------------------------------------ rule units
+
+
+def test_slo_threshold_rule_streak_and_recovery():
+  m = SLOMonitor([SLORule("ttft", "ttft_p99_s", "<=", 0.5,
+                          for_records=2)])
+  m.observe(1, {"serving/ttft_p99_s": 0.9})
+  assert m.breaches == 0          # debounce: one bad record is noise
+  m.observe(2, {"serving/ttft_p99_s": 0.9})
+  assert m.breaches == 1
+  m.observe(3, {"serving/ttft_p99_s": 0.9})
+  assert m.breaches == 1          # still the same breach window
+  m.observe(4, {"serving/ttft_p99_s": 0.1})
+  assert m.recoveries == 1
+  m.observe(5, {"serving/ttft_p99_s": 0.9})
+  m.observe(6, {"serving/ttft_p99_s": 0.9})
+  assert m.breaches == 2          # a fresh window needs a fresh streak
+
+
+def test_slo_rule_suffix_matching_tracks_separate_streams():
+  m = SLOMonitor([SLORule("itl", "itl_p99_s", "<=", 0.1)])
+  m.observe(1, {"serving/fleet/itl_p99_s": 0.5,
+                "serving/replica0/itl_p99_s": 0.05})
+  assert m.breaches == 1
+  assert m.status() == {"itl@serving/fleet/itl_p99_s": "breach",
+                        "itl@serving/replica0/itl_p99_s": "ok"}
+
+
+def test_burn_rate_rule_fast_and_slow_windows():
+  rule = BurnRateRule("shed", bad="shed", good="finished_requests",
+                      objective=0.9, fast_window=2, slow_window=6,
+                      fast_burn=3.0, slow_burn=2.0)
+  m = SLOMonitor([rule])
+  shed, fin = 0.0, 0.0
+  # Healthy traffic: 2% shed against a 10% budget -> burn 0.2x.
+  for step in range(7):
+    shed += 1
+    fin += 49
+    m.observe(step, {"serving/fleet/shed": shed,
+                     "serving/fleet/finished_requests": fin})
+  assert m.breaches == 0
+  # A short spike trips the fast window but not the slow one: no page.
+  m.observe(7, {"serving/fleet/shed": shed + 30,
+                "serving/fleet/finished_requests": fin + 20})
+  assert m.breaches == 0
+  # Sustained 60% shedding: both windows exceed -> breach, then
+  # recovery once the fast window is clean again.
+  for step in range(8, 14):
+    shed += 30
+    fin += 20
+    m.observe(step, {"serving/fleet/shed": shed,
+                     "serving/fleet/finished_requests": fin})
+  assert m.breaches == 1
+  for step in range(14, 18):
+    fin += 50
+    m.observe(step, {"serving/fleet/shed": shed,
+                     "serving/fleet/finished_requests": fin})
+  assert m.recoveries == 1
+
+
+def test_monitor_skips_device_arrays_and_idle_burn_windows():
+  """Raw registry pass-through can carry device arrays; evaluating one
+  would force the host sync the sinks defer — they must be skipped, not
+  floated.  And a burn rule with zero traffic renders no verdict."""
+  m = SLOMonitor([SLORule("loss", "loss", "<=", 0.1),
+                  BurnRateRule("b", bad="shed", good="finished_requests",
+                               objective=0.9, fast_window=1,
+                               slow_window=2)])
+  dev = jnp.asarray(5.0)          # would breach if evaluated
+  for step in range(4):
+    m.observe(step, {"train/loss": dev, "serving/shed": 0.0,
+                     "serving/finished_requests": 0.0})
+  assert m.breaches == 0
+  assert m.status().get("loss@train/loss") is None  # never evaluated
+
+
+def test_rules_from_config_and_validation():
+  conf = epl.Config({"observability": {"slo": {
+      "enabled": True, "ttft_p99_s": 0.5, "itl_p99_s": 0.05,
+      "shed_objective": 0.95}}})
+  names = [r.name for r in
+           slo_lib.rules_from_config(conf.observability.slo)]
+  assert names == ["ttft_p99", "itl_p99", "shed_burn", "replica_down"]
+  conf2 = epl.Config({"observability": {"slo": {
+      "enabled": True, "replicas_down": False}}})
+  assert [r.name for r in
+          slo_lib.rules_from_config(conf2.observability.slo)] == []
+  with pytest.raises(ValueError, match="shed_objective"):
+    epl.Config({"observability.slo.shed_objective": 1.0})
+  with pytest.raises(ValueError, match="fast_window"):
+    epl.Config({"observability": {"slo": {"fast_window": 9,
+                                          "slow_window": 3}}})
+  with pytest.raises(ValueError, match="capture_limit"):
+    epl.Config({"observability.slo.capture_limit": 0})
+  with pytest.raises(ValueError, match="ttft_p99_s"):
+    epl.Config({"observability.slo.ttft_p99_s": -1.0})
+
+
+def test_ensure_configured_ambient_and_explicit_install():
+  slo_lib.reset()
+  epl.init(epl.Config({"observability": {"slo": {
+      "enabled": True, "ttft_p99_s": 1.0}}}))
+  m1 = slo_lib.ensure_configured()
+  assert m1 is not None and [r.name for r in m1.rules] == [
+      "ttft_p99", "replica_down"]
+  assert slo_lib.ensure_configured() is m1
+  # A component's foreign config (slo off there) must not tear down the
+  # run's monitor — same contract as the tracer's ensure_configured.
+  foreign = epl.Config({"serving.num_slots": 2})
+  assert slo_lib.ensure_configured(foreign) is m1
+  epl.init()                      # ambient off -> torn down
+  assert slo_lib.ensure_configured() is None
+  mine = SLOMonitor([])
+  slo_lib.install(mine)
+  epl.init()
+  assert slo_lib.ensure_configured() is mine  # explicit install wins
+  slo_lib.reset()
+
+
+# ------------------------------------------------- sentinel & capture
+
+
+def test_compile_sentinel_watermark_and_attribution():
+  sizes = iter([1, 1, 3, 3, 4])
+  fired = []
+  s = CompileSentinel("twin", lambda: next(sizes),
+                      on_recompile=[lambda *a: fired.append(a)])
+  assert s.check() == 0           # warmup compile is expected
+  assert s.check() == 0
+  assert s.check(lambda: {"tokens": "int32[2,4]"}) == 2
+  assert s.check() == 0           # watermark moved; no re-fire
+  assert s.check() == 1
+  assert s.recompiles == 3
+  assert fired[0][:3] == ("twin", 3, 2)
+  assert fired[0][3] == {"tokens": "int32[2,4]"}
+
+
+def test_compile_sentinel_survives_unreadable_cache():
+  def boom():
+    raise AttributeError("no _cache_size on this callable")
+  s = CompileSentinel("twin", boom)
+  assert s.check() == 0 and s.check() == 0  # degrades, never raises
+
+
+def test_sentinel_breach_reaches_monitor_and_capture(tmp_path):
+  cap = DiagnosticCapture(str(tmp_path), min_interval_s=0.0)
+  m = SLOMonitor([], events_path=str(tmp_path / "ev.jsonl"),
+                 capture=cap)
+  heard = []
+  m.add_listener(lambda name, payload: heard.append((name, payload)))
+  sizes = iter([1, 2])
+  s = CompileSentinel(
+      "fused_step", lambda: next(sizes),
+      on_recompile=[lambda label, size, extra, sig: m.note_event(
+          "unexpected_recompile",
+          {"twin": label, "cache_size": size, "signature": str(sig)})])
+  s.check()
+  s.check(lambda: "f32[4,8]")
+  assert m.breaches == 1
+  assert heard and heard[0][0] == "unexpected_recompile"
+  (line,) = [json.loads(l) for l in open(tmp_path / "ev.jsonl")]
+  assert line["rule"] == "unexpected_recompile"
+  assert line["signature"] == "f32[4,8]"
+  assert any(d.startswith("bundle_") for d in os.listdir(tmp_path))
+  m.close()
+
+
+def test_diagnostic_capture_rate_limit_and_retention(tmp_path):
+  t = [0.0]
+  cap = DiagnosticCapture(str(tmp_path), limit=2, min_interval_s=10.0,
+                          clock=lambda: t[0])
+  assert cap.capture("first") is not None
+  assert cap.capture("suppressed") is None      # inside the interval
+  assert cap.suppressed == 1
+  for i in range(3):
+    t[0] += 11.0
+    assert cap.capture(f"later{i}") is not None
+  bundles = sorted(os.listdir(tmp_path))
+  assert len(bundles) == 2                      # retention bound
+  assert all(not b.endswith(".tmp") for b in bundles)
+  assert "later2" in bundles[-1]                # oldest evicted first
+
+
+# ------------------------------------------------- reservoir & follow
+
+
+def test_reservoir_deterministic_and_capped():
+  a, b = _Reservoir(8), _Reservoir(8)
+  for i in range(1000):
+    a.add(float(i))
+    b.add(float(i))
+  assert a.items == b.items                     # deterministic
+  assert len(a.items) == 8 and a.count == 1000
+  assert all(0 <= x < 1000 for x in a.items)
+  small = _Reservoir(8)
+  for i in range(5):
+    small.add(float(i))
+  assert small.items == [0.0, 1.0, 2.0, 3.0, 4.0]  # exact below cap
+
+
+def test_serving_stats_samples_bounded_and_merge_bounded():
+  t = [0.0]
+  stats = ServingStats(clock=lambda: t[0], sample_limit=16)
+  for i in range(200):
+    uid = f"r{i}"
+    stats.note_submitted(uid)
+    t[0] += 0.01
+    stats.note_first_token(uid)
+    t[0] += 0.05
+    stats.note_finished(uid, new_tokens=3)
+  assert len(stats.ttft_samples()) == 16
+  assert len(stats.itl_samples()) == 16
+  assert stats.finished_requests == 200         # aggregates keep all
+  s = stats.summary()
+  assert s["ttft_p50_s"] == pytest.approx(0.01)
+  assert s["itl_p50_s"] == pytest.approx(0.025)
+  from easyparallellibrary_tpu.profiler.serving import fleet_summary
+  fleet = fleet_summary([stats, stats])
+  assert fleet["ttft_p50_s"] == pytest.approx(0.01)
+
+
+def test_report_follow_tails_metrics_and_slo(tmp_path):
+  metrics = tmp_path / "metrics.jsonl"
+  slo = tmp_path / "slo_events.jsonl"
+  metrics.write_text(json.dumps({
+      "step": 3, "serving/fleet/replicas": 2.0,
+      "serving/fleet/tokens_per_s": 42.0,
+      "serving/fleet/replicas_healthy": 2.0}) + "\n")
+  slo.write_text("")
+  st = report.FollowState(str(metrics), str(slo))
+  first = st.poll()
+  assert first is not None and "42.0 tok/s" in first
+  assert "no events" in first
+  assert st.poll() is None                      # nothing new
+  # Records append mid-run — including a PARTIAL trailing line, which
+  # must wait for its newline instead of being half-parsed.
+  with open(metrics, "a") as f:
+    f.write(json.dumps({"step": 9, "serving/fleet/replicas": 2.0,
+                        "serving/fleet/tokens_per_s": 77.0,
+                        "serving/fleet/replicas_down": 1.0}) + "\n")
+    f.write('{"step": 10, "serving/fl')          # mid-write
+  with open(slo, "a") as f:
+    f.write(json.dumps({"time": 1.0, "event": "breach",
+                        "rule": "replica_down",
+                        "metric": "serving/fleet/replicas_down",
+                        "value": 1.0, "target": 0.0}) + "\n")
+  second = st.poll()
+  assert second is not None and "77.0 tok/s" in second
+  assert "replica_down@serving/fleet/replicas_down: BREACH" in second
+  assert st.records == 2                        # partial line not eaten
+  # The CLI entry point drives the same machinery.
+  assert report.main(["--follow", str(metrics), "--slo", str(slo),
+                      "--max-polls", "1", "--interval", "0"]) == 0
+
+
+def test_validate_trace_flow_negatives():
+  base = {"pid": 0, "tid": 0, "cat": "serving"}
+  with pytest.raises(ValueError, match="never terminated"):
+    validate_trace([{"ph": "s", "name": "flow", "ts": 1.0, "id": 7,
+                     **base}])
+  with pytest.raises(ValueError, match="no open flow"):
+    validate_trace([{"ph": "t", "name": "flow", "ts": 1.0, "id": 7,
+                     **base}])
+  with pytest.raises(ValueError, match="no open flow"):
+    validate_trace([{"ph": "f", "name": "flow", "ts": 1.0, "id": 7,
+                     **base}])
+  with pytest.raises(ValueError, match="started again"):
+    validate_trace([
+        {"ph": "s", "name": "flow", "ts": 1.0, "id": 7, **base},
+        {"ph": "s", "name": "flow", "ts": 2.0, "id": 7, **base},
+        {"ph": "f", "name": "flow", "ts": 3.0, "id": 7, **base}])
+  with pytest.raises(ValueError, match="missing 'id'"):
+    validate_trace([{"ph": "s", "name": "flow", "ts": 1.0, **base}])
+  # A complete s -> t -> f flow (id reused AFTER termination) is valid.
+  validate_trace([
+      {"ph": "s", "name": "flow", "ts": 1.0, "id": 7, **base},
+      {"ph": "t", "name": "flow", "ts": 2.0, "id": 7, **base},
+      {"ph": "f", "name": "flow", "ts": 3.0, "id": 7, **base},
+      {"ph": "s", "name": "flow", "ts": 4.0, "id": 7, **base},
+      {"ph": "f", "name": "flow", "ts": 5.0, "id": 7, **base}])
