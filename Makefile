@@ -8,6 +8,14 @@ build:
 test: build
 	python -m pytest tests/ -x -q
 
+# epl-lint: static invariant checker (compile-once, host-sync,
+# donation, metric schema, span pairing, lock discipline) over the
+# package — exits non-zero on any non-baselined finding
+# (docs/static_analysis.md; the quick-marked tests/test_analysis.py
+# zero-findings test enforces the same gate in tier-1).
+lint:
+	python -m easyparallellibrary_tpu.analysis
+
 bench:
 	python bench.py
 
@@ -76,6 +84,7 @@ help:
 	@echo "Targets:"
 	@echo "  build          - build the native IO extension (csrc/)"
 	@echo "  test           - full pytest suite (stops on first failure)"
+	@echo "  lint           - epl-lint static invariant checker (zero findings gate)"
 	@echo "  bench          - official perf capture (bench.py)"
 	@echo "  chaos          - training fault-injection suite"
 	@echo "  chaos-serve    - serving resilience chaos (NaN/hang/overload)"
@@ -93,4 +102,4 @@ help:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test bench chaos chaos-serve chaos-router serve-bench paged-bench spec-bench overload-bench router-bench trace-demo obs-bench help clean
+.PHONY: all build test lint bench chaos chaos-serve chaos-router serve-bench paged-bench spec-bench overload-bench router-bench trace-demo obs-bench help clean

@@ -1,0 +1,16 @@
+"""epl-lint: static invariant checker for this package's hard
+contracts — compile-once fused steps, zero implicit host syncs on hot
+paths, donated-buffer hygiene, the metric namespace schema, B/E span
+pairing, and tracer/watchdog lock discipline.
+
+Run it with ``python -m easyparallellibrary_tpu.analysis`` (or ``make
+lint``); the quick-marked ``tests/test_analysis.py`` keeps the package
+at zero non-baselined findings.  docs/static_analysis.md has the rule
+table, the suppression syntax, and the baseline workflow.
+"""
+
+from easyparallellibrary_tpu.analysis.core import (  # noqa: F401
+    Analyzer, Finding, apply_baseline, default_baseline_path,
+    load_baseline, package_root, write_baseline)
+from easyparallellibrary_tpu.analysis.rules import (  # noqa: F401
+    default_rules)

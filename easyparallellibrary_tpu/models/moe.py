@@ -320,6 +320,8 @@ class MoEMLP(nn.Module):
     # jit here is inlined under an outer jit; it also makes EAGER
     # evaluation (flax init) work — jax 0.9's eager shard_map
     # mis-validates out_specs when axis_names is a subset of the mesh.
+    # epl-lint: disable=recompile-hazard — inlined under the outer jit
+    # (traced once per outer compile); the eager path is init-only
     out, aux = jax.jit(mapped)(x.reshape(T, D), router_kernel, wi, wo)
     self.sow("losses", "moe_aux_loss", aux,
              init_fn=lambda: jnp.float32(0),

@@ -41,6 +41,14 @@ from typing import Any, Dict, List, Mapping
 
 NAMESPACES = ("train", "serving", "comm", "resilience")
 
+# Well-known sub-namespaces, shared so producers (serving/router.py)
+# and consumers (observability/report.py's rollup/--follow readers)
+# never restate the literal — epl-lint's metric-schema rule validates
+# every literal namespace at publish/namespaced() call sites against
+# the roots above.
+SERVING_NAMESPACE = "serving"
+FLEET_NAMESPACE = "serving/fleet"
+
 # The key->namespace rule for producers that accumulate one flat mixed
 # metrics dict (fit's step metrics, the profilers' summaries).  Shared
 # here so the same key never lands under train/* in one record and

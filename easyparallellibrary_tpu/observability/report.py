@@ -39,6 +39,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from easyparallellibrary_tpu.observability.registry import FLEET_NAMESPACE
 from easyparallellibrary_tpu.profiler.serving import percentile
 
 
@@ -200,7 +201,7 @@ def fleet_rollup(metrics_path: str) -> Optional[Dict[str, Any]]:
   with the namespace prefix stripped — or None when the file holds no
   fleet record.  Lenient to trailing partial lines (a live server's
   sink may be mid-write) — post-mortems read partial logs."""
-  prefix = "serving/fleet/"
+  prefix = FLEET_NAMESPACE + "/"
   last: Optional[Dict[str, Any]] = None
   try:
     with open(metrics_path) as f:
@@ -309,7 +310,7 @@ class FollowState:
 
   def poll(self) -> Optional[str]:
     changed = False
-    prefix = "serving/fleet/"
+    prefix = FLEET_NAMESPACE + "/"
     for rec in self._read_new_lines(self.metrics_path):
       self.records += 1
       changed = True

@@ -120,6 +120,9 @@ def create_sharded_train_state(init_fn: Callable[..., Any],
     from easyparallellibrary_tpu.runtime.offload import offload_to_host
     shardings = offload_to_host(shardings)
   with jax.transfer_guard("allow"):
+    # epl-lint: disable=recompile-hazard — one-shot sharded init: runs
+    # once per train-state construction, materializing params directly
+    # in their target layout
     state = jax.jit(init_fn, out_shardings=shardings)(*init_args)
   return state, shardings
 

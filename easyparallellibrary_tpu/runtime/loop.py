@@ -336,10 +336,14 @@ def fit(step_fn: Callable,
         watchdog.disarm()
       if check_every and (step_idx + 1) % check_every == 0 \
           and "bad_steps" in metrics:
-        bad = int(metrics["bad_steps"])  # one sync per window, amortized
+        # epl-lint: disable=host-sync — the sentinel's designed read: one
+        # sync per max_bad_steps window, amortized, never per step
+        bad = int(metrics["bad_steps"])
         if profiler is not None and hasattr(profiler, "note_bad_step") \
             and "bad_steps_total" in metrics:
-          total_bad = int(metrics["bad_steps_total"])  # same sync window
+          # epl-lint: disable=host-sync — same amortized window as the
+          # bad_steps read above; no additional per-step sync
+          total_bad = int(metrics["bad_steps_total"])
           if total_bad > fed["bad"]:
             profiler.note_bad_step(total_bad - fed["bad"])
           fed["bad"] = total_bad
@@ -404,8 +408,11 @@ def fit(step_fn: Callable,
         with tracer.span("train/host_sync", cat="train", track="train",
                          record=step_rec):
           loss = metrics.get("loss")
-          log.info("step %d: loss %s", step_idx + 1,
-                   f"{float(loss):.5f}" if loss is not None else "n/a")
+          # epl-lint: disable=host-sync — the loop's ONE designated
+          # periodic sync point (log_every boundary), wrapped in the
+          # train/host_sync span precisely because it syncs
+          loss_text = f"{float(loss):.5f}" if loss is not None else "n/a"
+          log.info("step %d: loss %s", step_idx + 1, loss_text)
       if (checkpoint_dir and checkpoint_every
           and (step_idx + 1) % checkpoint_every == 0):
         saver.save_checkpoint(checkpoint_dir, _ckpt_tree(state),

@@ -252,7 +252,12 @@ class StepWatchdog:
       self._fire(step)
 
   def _fire(self, step: int):
-    self.timeouts_fired += 1
+    # Monitor-thread write, host-loop readers (the router's health
+    # beats read timeouts_fired between sweeps): `+=` is not
+    # GIL-atomic, so the counter shares the condition's lock like
+    # every other cross-thread field of this class.
+    with self._cond:
+      self.timeouts_fired += 1
     # Instant event from the monitor thread (its own trace track): the
     # wedged window shows up IN the timeline next to whatever phase
     # span never closed.

@@ -70,6 +70,8 @@ import numpy as np
 from easyparallellibrary_tpu.env import Env
 from easyparallellibrary_tpu.observability import slo as slo_lib
 from easyparallellibrary_tpu.observability import trace as trace_lib
+from easyparallellibrary_tpu.observability.registry import (
+    SERVING_NAMESPACE, MetricRegistry)
 from easyparallellibrary_tpu.serving import kv_cache as kv_lib
 from easyparallellibrary_tpu.serving._capabilities import (
     check_draft_fits_chunk, check_servable)
@@ -1017,7 +1019,7 @@ class ContinuousBatchingEngine:
         slot_starts[slot] = int(plan.positions[plan.base_idx[slot]])
       else:  # RETRY: zero the bad step's uncommitted writes only.
         if cursors is None:  # host sync on the rare bad-step path only
-          cursors = np.asarray(self._cursors)
+          cursors = jax.device_get(self._cursors)
         slot_starts[slot] = int(cursors[slot])
     if slot_starts and self.paged:
       self._sanitize_paged(slot_starts, blocks_by_slot)
@@ -1114,7 +1116,7 @@ class ContinuousBatchingEngine:
               plan.tok_index, plan.temperature, plan.top_k, plan.top_p)
           if self._resilient:
             committed, n_committed, ok_dev, self._kv = out
-            slot_ok = np.asarray(ok_dev)
+            slot_ok = jax.device_get(ok_dev)
           else:
             committed, n_committed, self._kv = out
         else:
@@ -1125,11 +1127,16 @@ class ContinuousBatchingEngine:
               plan.top_p)
           if self._resilient:
             committed, n_committed, ok_dev, self._kv, self._cursors = out
-            slot_ok = np.asarray(ok_dev)
+            slot_ok = jax.device_get(ok_dev)
           else:
             committed, n_committed, self._kv, self._cursors = out
-        committed = np.asarray(committed)
-        n_committed = np.asarray(n_committed)
+        # The step's ONE designated token fetch: explicit (device_get),
+        # so it stays visible — and legal — under
+        # jax.transfer_guard_device_to_host("disallow"); any OTHER
+        # device->host crossing in this loop is a bug the guard (and
+        # epl-lint's host-sync rule) catches.
+        committed = jax.device_get(committed)
+        n_committed = jax.device_get(n_committed)
         t1_us = tracer.now_us()
         tracer.span_at("serving/device_step", t0_us, t1_us,
                        cat="serving", track="serving")
@@ -1159,7 +1166,7 @@ class ContinuousBatchingEngine:
               plan.temperature, plan.top_k, plan.top_p)
           if self._resilient:
             nxt, ok_dev, self._kv = out
-            slot_ok = np.asarray(ok_dev)
+            slot_ok = jax.device_get(ok_dev)
           else:
             nxt, self._kv = out
         else:
@@ -1169,10 +1176,11 @@ class ContinuousBatchingEngine:
               plan.temperature, plan.top_k, plan.top_p)
           if self._resilient:
             nxt, ok_dev, self._kv, self._cursors = out
-            slot_ok = np.asarray(ok_dev)
+            slot_ok = jax.device_get(ok_dev)
           else:
             nxt, self._kv, self._cursors = out
-        nxt = np.asarray(nxt)
+        # Designated fetch (see the speculative branch above).
+        nxt = jax.device_get(nxt)
         t1_us = tracer.now_us()
         tracer.span_at("serving/device_step", t0_us, t1_us,
                        cat="serving", track="serving")
@@ -1266,9 +1274,11 @@ class ContinuousBatchingEngine:
         self.registry.publish(self._steps, record, "serving")
       elif self._slo is not None:
         # Registry-less engine: feed the monitor the same namespaced
-        # record directly (host scalars only — no added syncs).
-        self._slo.observe(self._steps,
-                          {f"serving/{k}": v for k, v in record.items()})
+        # record directly (host scalars only — no added syncs), through
+        # the validated schema helper rather than an ad-hoc key literal.
+        self._slo.observe(
+            self._steps,
+            MetricRegistry.namespaced(SERVING_NAMESPACE, record))
     if (self.stats is not None
         and self._steps % _STATS_PUBLISH_EVERY == 0
         and (self.registry is not None or self._slo is not None)):
@@ -1279,8 +1289,8 @@ class ContinuousBatchingEngine:
       else:
         self._slo.observe(
             self._steps,
-            {f"serving/{k}": v
-             for k, v in self.stats.summary().items()})
+            MetricRegistry.namespaced(SERVING_NAMESPACE,
+                                      self.stats.summary()))
     return finished
 
   def run(self, max_steps: Optional[int] = None

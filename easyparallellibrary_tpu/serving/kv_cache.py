@@ -123,7 +123,12 @@ def allocate_kv_cache(cfg, num_slots: int, chunk: int,
     return kv, jnp.zeros((num_slots,), jnp.int32)
 
   if kv_shardings is None:
+    # epl-lint: disable=recompile-hazard — allocation-time one-shot:
+    # runs once per engine construction (jit materializes the zeros
+    # DIRECTLY in their layout, never through a host buffer)
     return jax.jit(build)()
+  # epl-lint: disable=recompile-hazard — same one-shot allocation, mesh
+  # path (out_shardings places each leaf as it is created)
   return jax.jit(build, out_shardings=(kv_shardings, cur_sharding))()
 
 
@@ -197,7 +202,11 @@ def allocate_paged_kv_cache(cfg, num_blocks: int, block_size: int,
             for i in range(cfg.num_layers)}
 
   if kv_shardings is None:
+    # epl-lint: disable=recompile-hazard — allocation-time one-shot
+    # (see allocate_kv_cache: pool zeros materialize in place, once)
     return jax.jit(build)()
+  # epl-lint: disable=recompile-hazard — same one-shot allocation on
+  # the mesh path
   return jax.jit(build, out_shardings=kv_shardings)()
 
 

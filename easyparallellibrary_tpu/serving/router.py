@@ -64,6 +64,8 @@ import numpy as np
 from easyparallellibrary_tpu.env import Env
 from easyparallellibrary_tpu.observability import slo as slo_lib
 from easyparallellibrary_tpu.observability import trace as trace_lib
+from easyparallellibrary_tpu.observability.registry import (
+    FLEET_NAMESPACE, MetricRegistry)
 from easyparallellibrary_tpu.profiler.serving import fleet_summary
 from easyparallellibrary_tpu.serving.replica import EngineReplica
 from easyparallellibrary_tpu.serving.resilience import ReplicaHealth
@@ -380,11 +382,14 @@ class Router:
     rollup = self.fleet_summary()
     if self.registry is not None:
       # The SLO monitor rides the registry as a sink (attach at init).
-      self.registry.publish(self.steps, rollup, "serving/fleet")
+      self.registry.publish(self.steps, rollup, FLEET_NAMESPACE)
     elif self._slo is not None:
+      # Registry-less fleet: same validated schema helper the registry
+      # path uses — never an ad-hoc key literal (namespaced() validates
+      # the root; report.py reads back through the same constant).
       self._slo.observe(self.steps,
-                        {f"serving/fleet/{k}": v
-                         for k, v in rollup.items()})
+                        MetricRegistry.namespaced(FLEET_NAMESPACE,
+                                                  rollup))
 
   def _reap(self, now: float) -> None:
     """Fail over any down replica still holding requests.  Idempotent —
@@ -612,7 +617,7 @@ class Router:
   def publish(self, registry, step: int) -> None:
     """Publish the rollup under ``serving/fleet/*`` (every replica's own
     records live under ``serving/replica<i>/*`` beside it)."""
-    registry.publish(step, self.fleet_summary(), "serving/fleet")
+    registry.publish(step, self.fleet_summary(), FLEET_NAMESPACE)
 
   # ----------------------------------------------------------- lifecycle
 

@@ -313,7 +313,10 @@ class DraftModelDrafter(Drafter):
       else:
         toks, self._kv = self._fn(self.params, self._kv, self._cursors,
                                   plan.tokens, plan.num_valid, plan.reset)
-      toks = np.asarray(toks)
+      # The drafter's one designated fetch — explicit, like the
+      # engine's token fetch, so the serving loop stays legal under
+      # jax.transfer_guard_device_to_host("disallow").
+      toks = jax.device_get(toks)
     counts = np.minimum(plan.draft_cap, self.k).astype(np.int32)
     return toks, counts
 

@@ -1,0 +1,620 @@
+"""epl-lint static analysis (easyparallellibrary_tpu/analysis/).
+
+Three layers of coverage (ISSUE 10 acceptance):
+
+* per-rule positives/negatives over synthetic fixture packages written
+  to tmp_path — each rule must flag the seeded violation at the right
+  ``path:line`` and stay silent on the idiomatic counterpart;
+* the suppression + baseline machinery round-trips (a justified inline
+  disable silences exactly its rule; a reason-less disable is itself a
+  finding; grandfathered fingerprints absorb findings once);
+* the CLI smoke test and the quick-marked acceptance: the SHIPPED
+  package yields zero non-baselined findings, so the suite self-
+  enforces the invariants forever (``make lint`` is the same check).
+
+Pure host-side tests — no jax import, no device work; the whole module
+runs in a few seconds.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from easyparallellibrary_tpu.analysis import (
+    Analyzer, apply_baseline, default_baseline_path, load_baseline,
+    package_root, write_baseline)
+from easyparallellibrary_tpu.analysis.core import Suppressions
+
+
+def _write(root, rel, src):
+  path = os.path.join(str(root), rel)
+  os.makedirs(os.path.dirname(path), exist_ok=True)
+  with open(path, "w") as f:
+    f.write(textwrap.dedent(src))
+  return path
+
+
+def _run(root):
+  return Analyzer(str(root)).run()
+
+
+def _by_rule(findings, rule):
+  return [f for f in findings if f.rule == rule]
+
+
+# ------------------------------------------------------------ host-sync
+
+
+def test_host_sync_flags_implicit_fetch_with_path_and_line(tmp_path):
+  _write(tmp_path, "serving/eng.py", """\
+      import jax
+      import numpy as np
+
+
+      def make_step():
+        return jax.jit(lambda x: x)
+
+
+      class Engine:
+        def __init__(self):
+          self._step_fn = make_step()
+
+        def step(self, plan):
+          out = self._step_fn(plan)
+          toks = np.asarray(out)
+          return toks
+      """)
+  findings = _by_rule(_run(tmp_path), "host-sync")
+  assert len(findings) == 1
+  f = findings[0]
+  assert f.path == "serving/eng.py"
+  assert f.line == 15  # the np.asarray line, exactly
+  assert "np.asarray" in f.message
+
+
+def test_host_sync_allows_device_get_and_cold_paths(tmp_path):
+  # device_get is the sanctioned explicit fetch; the same implicit
+  # fetch OUTSIDE a hot path (models/) is not this rule's business.
+  _write(tmp_path, "serving/eng.py", """\
+      import jax
+
+
+      class Engine:
+        def __init__(self):
+          self._step_fn = jax.jit(lambda x: x)
+
+        def step(self, plan):
+          out = self._step_fn(plan)
+          return jax.device_get(out)
+      """)
+  _write(tmp_path, "models/net.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def run(x):
+        return np.asarray(_fn(x))
+      """)
+  assert _by_rule(_run(tmp_path), "host-sync") == []
+
+
+def test_host_sync_fires_on_subdir_and_single_file_scans(tmp_path):
+  """Hot-path detection matches on the ABSOLUTE path, so pointing the
+  CLI at `.../serving` (or one file in it) must not read as clean on
+  the very file being linted."""
+  path = _write(tmp_path, "serving/eng.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def fetch(x):
+        return np.asarray(_fn(x))
+      """)
+  for root in (os.path.join(str(tmp_path), "serving"), path):
+    findings = _by_rule(_run(root), "host-sync")
+    assert [f.line for f in findings] == [8], root
+
+
+def test_host_sync_flags_implicit_bool_and_float(tmp_path):
+  _write(tmp_path, "runtime/loop.py", """\
+      def fit(step_fn, state, batch):
+        state, metrics = step_fn(state, batch)
+        if metrics:
+          pass
+        return float(metrics["loss"])
+      """)
+  findings = _by_rule(_run(tmp_path), "host-sync")
+  kinds = sorted(f.message.split(":")[1].split(" on ")[0].strip()
+                 for f in findings)
+  assert len(findings) == 2
+  assert "float()" in kinds[0] and "implicit bool()" in kinds[1]
+
+
+def test_host_sync_tracks_device_attrs_across_methods(tmp_path):
+  # self._kv holds a step result in one method; np-coercing it in
+  # ANOTHER method is still a sync (the engine's bad-step path).
+  _write(tmp_path, "serving/eng.py", """\
+      import jax
+      import numpy as np
+
+
+      class Engine:
+        def __init__(self):
+          self._step_fn = jax.jit(lambda x: x)
+          self._kv = None
+
+        def step(self, plan):
+          self._kv = self._step_fn(plan)
+
+        def recover(self):
+          return np.asarray(self._kv)
+      """)
+  findings = _by_rule(_run(tmp_path), "host-sync")
+  assert [f.line for f in findings] == [14]
+
+
+# ----------------------------------------------------- recompile-hazard
+
+
+def test_recompile_flags_jit_in_loop_and_per_call_wrapper(tmp_path):
+  _write(tmp_path, "kernels/k.py", """\
+      import jax
+
+
+      def sweep(xs):
+        for x in xs:
+          f = jax.jit(lambda y: y)
+          f(x)
+
+
+      def per_call(x):
+        return jax.jit(lambda y: y)(x)
+      """)
+  findings = _by_rule(_run(tmp_path), "recompile-hazard")
+  assert sorted(f.line for f in findings) == [6, 11]
+
+
+def test_recompile_flags_string_into_staticless_jit(tmp_path):
+  _write(tmp_path, "kernels/k.py", """\
+      import jax
+
+      _step = jax.jit(lambda s, mode: s)
+      _static = jax.jit(lambda s, mode: s, static_argnums=(1,))
+
+
+      def call(s):
+        return _step(s, f"mode{s}")
+
+
+      def ok(s):
+        return _static(s, "greedy")
+      """)
+  findings = _by_rule(_run(tmp_path), "recompile-hazard")
+  assert [f.line for f in findings] == [8]
+  assert "static_argnums" in findings[0].message
+
+
+def test_recompile_silent_on_cached_wrapper(tmp_path):
+  _write(tmp_path, "kernels/k.py", """\
+      import jax
+
+      _cache = {}
+
+
+      def step(x):
+        if "fn" not in _cache:
+          _cache["fn"] = jax.jit(lambda y: y)
+        return _cache["fn"](x)
+      """)
+  assert _by_rule(_run(tmp_path), "recompile-hazard") == []
+
+
+# --------------------------------------------------- donation-after-use
+
+
+def test_donation_flags_read_after_donated_call(tmp_path):
+  _write(tmp_path, "runtime/z.py", """\
+      import jax
+
+      _f = jax.jit(lambda kv: kv, donate_argnums=(0,))
+
+
+      def bad(kv):
+        out = _f(kv)
+        return kv + out
+
+
+      def good(kv):
+        kv = _f(kv)
+        return kv
+      """)
+  findings = _by_rule(_run(tmp_path), "donation-after-use")
+  assert [f.line for f in findings] == [8]
+  assert "'kv'" in findings[0].message
+
+
+def test_donation_reassign_inside_later_compound_is_clean(tmp_path):
+  """A reassignment nested in a later if/for body kills the donation
+  taint before any subsequent load in that same body — the load must
+  not be flagged through the compound parent's whole subtree."""
+  _write(tmp_path, "runtime/z.py", """\
+      import jax
+
+      _f = jax.jit(lambda kv: kv, donate_argnums=(0,))
+
+
+      def recover(kv, cond):
+        _f(kv)
+        if cond:
+          kv = make_fresh()
+          return use(kv)
+        return None
+      """)
+  assert _by_rule(_run(tmp_path), "donation-after-use") == []
+
+
+def test_donation_same_statement_reassign_is_clean(tmp_path):
+  # The engine idiom: the donated buffer is a target of the very
+  # statement holding the call (tuple unpack of the step outputs).
+  _write(tmp_path, "serving/eng.py", """\
+      import jax
+
+
+      class Engine:
+        def __init__(self):
+          self._fn = jax.jit(lambda kv, t: (t, kv), donate_argnums=(0,))
+          self._kv = None
+
+        def step(self, t):
+          toks, self._kv = self._fn(self._kv, t)
+          return jax.device_get(toks)
+      """)
+  assert _by_rule(_run(tmp_path), "donation-after-use") == []
+
+
+# -------------------------------------------------------- metric-schema
+
+
+def test_metric_schema_validates_publish_literals(tmp_path):
+  _write(tmp_path, "obs/emit.py", """\
+      def emit(reg, step, record):
+        reg.publish(step, record, "serving")
+        reg.publish(step, record, "latency/foo")
+        reg.publish_many(step, {"train": record, "bogus": record})
+        reg.publish(step, record, namespace="serving/fleet")
+        return reg.namespaced("queues/depth", record)
+      """)
+  findings = _by_rule(_run(tmp_path), "metric-schema")
+  assert sorted(f.line for f in findings) == [3, 4, 6]
+  assert all("schema roots" in f.message for f in findings)
+
+
+def test_metric_schema_reads_roots_from_registry_source(tmp_path):
+  _write(tmp_path, "observability/registry.py", """\
+      NAMESPACES = ("metrics",)
+      """)
+  _write(tmp_path, "obs/emit.py", """\
+      def emit(reg, step, record):
+        reg.publish(step, record, "metrics/a")
+        reg.publish(step, record, "train")
+      """)
+  findings = _by_rule(_run(tmp_path), "metric-schema")
+  assert [f.line for f in findings] == [3]
+  assert "['metrics']" in findings[0].message
+
+
+# --------------------------------------------------------- span-pairing
+
+
+def test_span_pairing_flags_discarded_span_and_orphan_end(tmp_path):
+  _write(tmp_path, "obs/t.py", """\
+      def a(tracer):
+        tracer.span("phase")
+
+
+      def b(tracer):
+        with tracer.span("phase"):
+          pass
+
+
+      def c(tracer, uid):
+        tracer.begin(f"request {uid}")
+
+
+      def d(tracer, state):
+        tracer.end(f"request {state.req.uid}")
+
+
+      def e(tracer):
+        tracer.end("orphan")
+      """)
+  findings = _by_rule(_run(tmp_path), "span-pairing")
+  assert sorted(f.line for f in findings) == [2, 19]
+  by_line = {f.line: f.message for f in findings}
+  assert "discarded" in by_line[2]          # span never entered
+  assert "no matching" in by_line[19]       # orphan end
+  # The f-string skeletons paired c's begin with d's end: no findings
+  # for lines 11/15.
+
+
+def test_span_pairing_flags_begin_without_end(tmp_path):
+  _write(tmp_path, "obs/t.py", """\
+      def open_only(tracer, uid):
+        tracer.begin(f"request {uid}")
+      """)
+  findings = _by_rule(_run(tmp_path), "span-pairing")
+  assert [f.line for f in findings] == [2]
+  assert "never closes" in findings[0].message
+
+
+# ------------------------------------------------------ lock-discipline
+
+
+def test_lock_discipline_flags_unlocked_write_to_guarded_attr(tmp_path):
+  _write(tmp_path, "obs/w.py", """\
+      import threading
+
+
+      class Ring:
+        def __init__(self):
+          self._lock = threading.Lock()
+          self._n = 0
+
+        def add(self):
+          with self._lock:
+            self._n += 1
+
+        def reset(self):
+          self._n = 0
+      """)
+  findings = _by_rule(_run(tmp_path), "lock-discipline")
+  assert [f.line for f in findings] == [14]
+  assert "'_n'" in findings[0].message
+
+
+def test_lock_discipline_flags_thread_path_public_write(tmp_path):
+  _write(tmp_path, "runtime/w.py", """\
+      import threading
+
+
+      class Watchdog:
+        def __init__(self):
+          self._cond = threading.Condition()
+          self.fired = 0
+
+        def start(self):
+          t = threading.Thread(target=self._run)
+          t.start()
+
+        def _run(self):
+          self._fire()
+
+        def _fire(self):
+          self.fired += 1
+      """)
+  findings = _by_rule(_run(tmp_path), "lock-discipline")
+  assert [f.line for f in findings] == [17]
+  assert "monitor-thread path" in findings[0].message
+
+
+def test_lock_discipline_clean_when_consistent(tmp_path):
+  _write(tmp_path, "runtime/w.py", """\
+      import threading
+
+
+      class Watchdog:
+        def __init__(self):
+          self._cond = threading.Condition()
+          self.fired = 0
+
+        def start(self):
+          t = threading.Thread(target=self._run)
+          t.start()
+
+        def _run(self):
+          with self._cond:
+            self.fired += 1
+      """)
+  assert _by_rule(_run(tmp_path), "lock-discipline") == []
+
+
+# ---------------------------------------------- suppressions + baseline
+
+
+def test_suppression_with_reason_silences_exactly_its_rule(tmp_path):
+  _write(tmp_path, "serving/eng.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def fetch(x):
+        out = _fn(x)
+        # epl-lint: disable=host-sync — designated fetch for this test
+        return np.asarray(out)
+
+
+      def still_flagged(x):
+        out = _fn(x)
+        return np.asarray(out)
+      """)
+  findings = _by_rule(_run(tmp_path), "host-sync")
+  assert [f.line for f in findings] == [15]
+
+
+def test_trailing_suppression_and_multi_rule_list(tmp_path):
+  _write(tmp_path, "serving/eng.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def fetch(x):
+        out = _fn(x)
+        return np.asarray(out)  # epl-lint: disable=host-sync,metric-schema — fetch
+      """)
+  assert _run(tmp_path) == []
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+  _write(tmp_path, "serving/eng.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def fetch(x):
+        out = _fn(x)
+        # epl-lint: disable=host-sync
+        return np.asarray(out)
+      """)
+  findings = _run(tmp_path)
+  rules = sorted(f.rule for f in findings)
+  # The justification-less disable does NOT suppress, and is itself
+  # reported.
+  assert rules == ["host-sync", "suppression"]
+
+
+def test_suppressions_bind_per_line():
+  sup = Suppressions("m.py", (
+      "x = 1\n"
+      "# epl-lint: disable=host-sync — standalone binds to next code\n"
+      "# (continuation comment)\n"
+      "y = 2\n"
+      "z = 3  # epl-lint: disable=span-pairing — trailing binds here\n"))
+  assert sup.is_suppressed("host-sync", 4)
+  assert not sup.is_suppressed("host-sync", 5)
+  assert sup.is_suppressed("span-pairing", 5)
+
+
+def test_baseline_round_trip(tmp_path):
+  _write(tmp_path, "serving/eng.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def fetch(x):
+        return np.asarray(_fn(x))
+      """)
+  findings = _run(tmp_path)
+  assert findings
+  baseline_path = str(tmp_path / "baseline.json")
+  write_baseline(baseline_path, findings)
+  new, old = apply_baseline(_run(tmp_path), load_baseline(baseline_path))
+  assert new == [] and len(old) == len(findings)
+  # A FRESH violation is not absorbed by the old fingerprints.
+  _write(tmp_path, "serving/eng2.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def fetch(x):
+        return np.asarray(_fn(x))
+      """)
+  new, old = apply_baseline(_run(tmp_path), load_baseline(baseline_path))
+  assert [f.path for f in new] == ["serving/eng2.py"]
+
+
+def test_baseline_absent_means_nothing_grandfathered(tmp_path):
+  assert load_baseline(str(tmp_path / "missing.json")) == {}
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_cli_smoke_subprocess(tmp_path):
+  """One real `python -m` invocation (module entry point, exit code,
+  path:line rendering); everything else drives main() in-process —
+  each subprocess pays the parent package's import, which the tier-1
+  budget cannot afford five times over."""
+  _write(tmp_path, "serving/eng.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def fetch(x):
+        return np.asarray(_fn(x))
+      """)
+  res = subprocess.run(
+      [sys.executable, "-m", "easyparallellibrary_tpu.analysis",
+       str(tmp_path)],
+      capture_output=True, text=True, cwd=os.path.dirname(package_root()))
+  assert res.returncode == 1
+  assert "serving/eng.py:8" in res.stdout and "[host-sync]" in res.stdout
+
+
+def test_cli_baseline_roundtrip_in_process(tmp_path, capsys):
+  from easyparallellibrary_tpu.analysis.__main__ import main
+  _write(tmp_path, "serving/eng.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def fetch(x):
+        return np.asarray(_fn(x))
+      """)
+  assert main([str(tmp_path)]) == 1
+  baseline = str(tmp_path / "bl.json")
+  assert main([str(tmp_path), "--baseline", baseline,
+               "--write-baseline"]) == 0
+  capsys.readouterr()
+  assert main([str(tmp_path), "--baseline", baseline]) == 0
+  assert "baselined finding(s)" in capsys.readouterr().out
+
+
+def test_cli_list_rules(capsys):
+  from easyparallellibrary_tpu.analysis.__main__ import main
+  assert main(["--list-rules"]) == 0
+  out = capsys.readouterr().out
+  for rule in ("host-sync", "recompile-hazard", "donation-after-use",
+               "metric-schema", "span-pairing", "lock-discipline"):
+    assert rule in out
+
+
+# ----------------------------------------------------------- acceptance
+
+
+@pytest.mark.quick
+def test_shipped_package_is_lint_clean():
+  """The acceptance gate (= ``make lint``): the shipped package yields
+  ZERO non-baselined findings — every invariant the rules encode holds
+  on every path, or is suppressed inline with a justification.  The
+  checked-in baseline must stay (near-)empty: this test prints any
+  regression with its path:line so the diff names the offender."""
+  findings = Analyzer(package_root()).run()
+  baseline = load_baseline(default_baseline_path())
+  new, old = apply_baseline(findings, baseline)
+  assert not new, "new epl-lint findings:\n" + "\n".join(
+      f.format() for f in new)
+  # The baseline ships empty; if someone grows it, this number forces
+  # the growth to be a visible, reviewed diff.
+  assert sum(baseline.values()) <= 2, (
+      "the epl-lint baseline should shrink, not grow "
+      f"({sum(baseline.values())} grandfathered findings)")
+
+
+def test_baseline_file_entries_are_live():
+  """Every grandfathered fingerprint must still match a real finding —
+  stale entries hide headroom for NEW violations of the same shape."""
+  findings = Analyzer(package_root()).run()
+  live = {f.fingerprint() for f in findings}
+  for fp, count in load_baseline(default_baseline_path()).items():
+    assert fp in live, f"stale baseline entry {fp}"

@@ -58,17 +58,24 @@ def test_engine_greedy_exact_vs_generate_staggered():
   max_new = (6, 7, 8, 4, 5, 9)
   eng = ContinuousBatchingEngine(model, params, num_slots=3,
                                  prefill_chunk=4)
-  for i in range(3):
-    eng.submit(Request(uid=i, prompt=prompts[i],
-                       max_new_tokens=max_new[i]))
-  out = {}
-  for _ in range(2):  # second wave joins a mid-flight batch
-    for fin in eng.step():
-      out[fin.uid] = fin.tokens
-  for i in range(3, len(prompts)):
-    eng.submit(Request(uid=i, prompt=prompts[i],
-                       max_new_tokens=max_new[i]))
-  out.update(eng.run())
+  # The whole serving drive runs under the device->host transfer guard:
+  # the engine's ONE designated per-step fetch is explicit
+  # (jax.device_get), so any IMPLICIT sync creeping into the hot loop —
+  # a float()/np.asarray on a device value — fails here at runtime,
+  # the complement of epl-lint's static host-sync rule
+  # (docs/static_analysis.md).
+  with jax.transfer_guard_device_to_host("disallow"):
+    for i in range(3):
+      eng.submit(Request(uid=i, prompt=prompts[i],
+                         max_new_tokens=max_new[i]))
+    out = {}
+    for _ in range(2):  # second wave joins a mid-flight batch
+      for fin in eng.step():
+        out[fin.uid] = fin.tokens
+    for i in range(3, len(prompts)):
+      eng.submit(Request(uid=i, prompt=prompts[i],
+                         max_new_tokens=max_new[i]))
+    out.update(eng.run())
   assert sorted(out) == list(range(len(prompts)))
   for i, p in enumerate(prompts):
     np.testing.assert_array_equal(
@@ -99,9 +106,11 @@ def test_engine_tp2_exact_vs_dense_generate():
                                         jax.random.PRNGKey(5))
   eng = ContinuousBatchingEngine(model, state.params, mesh=mesh,
                                  num_slots=2, prefill_chunk=4)
-  for i, p in enumerate(prompts):
-    eng.submit(Request(uid=i, prompt=p, max_new_tokens=5))
-  out = eng.run()
+  # Sync-free hot loop on the TP mesh too (see the staggered test).
+  with jax.transfer_guard_device_to_host("disallow"):
+    for i, p in enumerate(prompts):
+      eng.submit(Request(uid=i, prompt=p, max_new_tokens=5))
+    out = eng.run()
 
   dense = GPT(TINY)
   host_params = jax.tree_util.tree_map(np.asarray,
@@ -122,10 +131,12 @@ def test_slot_reuse_no_stale_kv_leakage():
   long_p, short_p = _prompts((12, 3), seed=3)
   eng = ContinuousBatchingEngine(model, params, num_slots=1,
                                  prefill_chunk=4)
-  eng.submit(Request(uid="long", prompt=long_p, max_new_tokens=10))
-  out = eng.run()
-  eng.submit(Request(uid="short", prompt=short_p, max_new_tokens=6))
-  out.update(eng.run())
+  # Slot reuse must stay sync-free as well (see the staggered test).
+  with jax.transfer_guard_device_to_host("disallow"):
+    eng.submit(Request(uid="long", prompt=long_p, max_new_tokens=10))
+    out = eng.run()
+    eng.submit(Request(uid="short", prompt=short_p, max_new_tokens=6))
+    out.update(eng.run())
   np.testing.assert_array_equal(out["long"],
                                 _oracle(model, params, long_p, 10))
   np.testing.assert_array_equal(out["short"],
