@@ -31,11 +31,23 @@ chaos-serve:
 # Router chaos: replica kills mid-decode, replica hangs, flapping health
 # against the multi-replica control plane — bit-exact failover, graceful
 # drain/rejoin, circuit breaker (docs/serving.md "Multi-replica serving")
-# — plus the fleet observability acceptance (one connected flow per
-# migrated request, SLO breach window logged, diagnostic bundle
-# captured; docs/observability.md "Reading a failover trace").
+# — ACROSS BOTH TRANSPORTS: the in-process simulations
+# (test_serving_router.py) and the process-isolated real fault domain
+# (test_serving_transport.py: SIGKILL/SIGSTOP/lost replies) — plus the
+# fleet observability acceptance (one connected flow per migrated
+# request, SLO breach window logged, diagnostic bundle captured;
+# docs/observability.md "Reading a failover trace").
 chaos-router:
-	python -m pytest tests/test_serving_router.py tests/test_observability_fleet.py -q
+	python -m pytest tests/test_serving_router.py tests/test_serving_transport.py tests/test_observability_fleet.py -q
+
+# Process-transport chaos standalone: subprocess replicas behind the
+# wire (serving/transport.py) — real os.kill(pid, SIGKILL) mid-decode
+# with journal recovery, SIGSTOP stalls tripping wire deadlines into
+# condemn+fence, dropped-reply exactly-once (uid dedup + watermark
+# resync), breaker-probe child respawn, and orphan reaping
+# (docs/robustness.md "Process-isolated replicas").
+chaos-proc:
+	python -m pytest tests/test_serving_transport.py -q
 
 # Continuous batching vs static-batch generate() under Poisson arrivals
 # (benchmarks/decode_throughput.py -> BENCH_EVIDENCE.json; docs/serving.md).
@@ -62,10 +74,14 @@ overload-bench:
 
 # Replica-kill failover episode: 1 vs 2 replicas under a Poisson trace,
 # then kill one mid-decode — zero lost requests, streams bit-exact vs
-# the fault-free baseline (benchmarks/router_failover.py ->
-# BENCH_EVIDENCE.json; docs/serving.md "Multi-replica serving").
+# the fault-free baseline — on BOTH transports: in-process replicas,
+# then process-isolated replicas (real SIGKILL, journal recovery,
+# N=1-vs-N=2 fleet tokens/s with the host-core-honest scaling number,
+# zero orphans) (benchmarks/router_failover.py -> BENCH_EVIDENCE.json;
+# docs/serving.md "Multi-replica serving" / "Replica transports").
 router-bench:
 	python benchmarks/router_failover.py
+	python benchmarks/router_failover.py --transport process
 
 # Tiny traced fit() + serving + router-failover episode on the CPU mesh
 # -> trace_demo.json (schema-validated incl. request-flow events; load
@@ -88,7 +104,8 @@ help:
 	@echo "  bench          - official perf capture (bench.py)"
 	@echo "  chaos          - training fault-injection suite"
 	@echo "  chaos-serve    - serving resilience chaos (NaN/hang/overload)"
-	@echo "  chaos-router   - fleet chaos: replica kills, hangs, flapping health"
+	@echo "  chaos-router   - fleet chaos: replica kills, hangs, flapping health (both transports)"
+	@echo "  chaos-proc     - process-transport chaos: SIGKILL/SIGSTOP/lost replies/orphans"
 	@echo "  serve-bench    - continuous batching vs static generate()"
 	@echo "  paged-bench    - paged vs contiguous KV cache (long-tail trace)"
 	@echo "  spec-bench     - speculative vs plain decode"
@@ -102,4 +119,4 @@ help:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test lint bench chaos chaos-serve chaos-router serve-bench paged-bench spec-bench overload-bench router-bench trace-demo obs-bench help clean
+.PHONY: all build test lint bench chaos chaos-serve chaos-router chaos-proc serve-bench paged-bench spec-bench overload-bench router-bench trace-demo obs-bench help clean

@@ -20,13 +20,25 @@ episode's failover/migration counts, and the two acceptance headlines:
 episode resolves exactly once) and ``bit_exact_vs_fault_free`` (every
 served stream identical to the fault-free baseline's, which is what
 "bit-exact failover" means end to end).  Honesty note on
-``tokens_per_s_scaling``: the router drives replicas synchronously on
-this host, so on the one-core CPU reference two replicas time-slice one
-core and scaling reads ~1.0x — the fleet's win here is AVAILABILITY
-(the kill episode), not CPU throughput; real scaling needs replicas on
-disjoint device sets.
+``tokens_per_s_scaling``: the router drives inproc replicas
+synchronously on this host, so on the one-core CPU reference two
+replicas time-slice one core and scaling reads ~1.0x — the inproc
+fleet's win here is AVAILABILITY (the kill episode), not CPU
+throughput.
 
-Run: ``python benchmarks/router_failover.py`` (or ``make router-bench``).
+``--transport process`` re-runs the episode suite on PROCESS-isolated
+replicas (serving/transport.py): each replica is a spawned subprocess
+owning its own JAX runtime, the router's two-phase step overlaps their
+sweeps, and the kill is a real ``os.kill(pid, SIGKILL)`` with recovery
+from the router-side journal.  Fleet tokens/s then multiplies with N
+up to the host's core count (scaling target >1.2x on a >=2-core box;
+a 1-core box still time-slices and the record says so — the
+``host_cores`` field is the context for the scaling number).  The
+record also asserts ``orphans_after == 0``: no child processes may
+outlive the bench or any chaos episode.
+
+Run: ``python benchmarks/router_failover.py [--transport process]``
+(or ``make router-bench``, which runs both).
 """
 
 from __future__ import annotations
@@ -185,5 +197,183 @@ def run(num_requests: int = 32, num_slots: int = 4, chunk: int = 4,
   return record
 
 
+PROCESS_METRIC = "router_failover_process"
+# Matches testing.factories.tiny_gpt kwargs for the bench model shape —
+# every child builds bit-identical params from this spec.
+PROCESS_FACTORY = {
+    "fn": "easyparallellibrary_tpu.testing.factories:tiny_gpt",
+    "kwargs": {"vocab_size": 256, "num_layers": 2, "num_heads": 8,
+               "d_model": 128, "d_ff": 512, "max_seq_len": 64,
+               "init_len": 6, "seed": 0},
+}
+
+
+def _process_episode(prompts, max_new, arrivals, *, replicas, num_slots,
+                     chunk, kill_at_step=None):
+  """One Poisson episode over ProcessTransport replicas on a virtual
+  clock; per-step wall time covers the router's two-phase sweep, so
+  concurrent children's overlap is what the clock sees."""
+  import easyparallellibrary_tpu as epl
+  from easyparallellibrary_tpu.testing import chaos as chaos_lib
+
+  config = epl.Config({"serving": {"router": {
+      "transport": "process", "rpc_timeout_s": 120.0}}})
+  router = Router(num_replicas=replicas, config=config,
+                  factory=PROCESS_FACTORY, num_slots=num_slots,
+                  prefill_chunk=chunk)
+  pids = [rep.child_pid for rep in router.replicas]
+  # Compile every child outside the clock.
+  for i in range(replicas):
+    router.replicas[i].submit(
+        Request(uid=f"warm{i}", prompt=prompts[0], max_new_tokens=2))
+  router.run()
+  killer = (chaos_lib.ProcessKiller(router.replicas[0])
+            if kill_at_step is not None else None)
+  n = len(arrivals)
+  clock, busy, nxt, steps = 0.0, 0.0, 0, 0
+  submit_at, first_at = {}, {}
+  first_this_step = []
+  for rep in router.replicas:
+    rep.on_first_token.append(first_this_step.append)
+  while nxt < n or router.has_work:
+    while nxt < n and arrivals[nxt] <= clock:
+      submit_at[nxt] = clock
+      router.submit(Request(uid=nxt, prompt=prompts[nxt],
+                            max_new_tokens=int(max_new[nxt])))
+      nxt += 1
+    if not router.has_work:
+      clock = arrivals[nxt]
+      continue
+    if killer is not None and steps == kill_at_step:
+      killer.kill()
+    t0 = time.perf_counter()
+    router.step()
+    dt = time.perf_counter() - t0
+    clock += dt
+    busy += dt
+    steps += 1
+    for uid in first_this_step:
+      if isinstance(uid, int):
+        first_at.setdefault(uid, clock)
+    first_this_step.clear()
+  served = [i for i in range(n)
+            if router.finished.get(i) is not None
+            and router.finished[i].finish_reason != "shed"]
+  ttfts = [first_at[i] - submit_at[i] for i in served if i in first_at]
+  useful = sum(router.finished[i].new_tokens for i in served)
+  outputs = {i: np.asarray(router.finished[i].tokens) for i in served}
+  from easyparallellibrary_tpu.profiler.serving import percentile
+  rec = {
+      "replicas": replicas,
+      "requests": n,
+      "served": len(served),
+      "resolved": sum(1 for i in range(n) if i in router.finished),
+      "tokens_per_s": useful / max(busy, 1e-9),
+      "ttft_p50_s": percentile(ttfts, 50),
+      "ttft_p99_s": percentile(ttfts, 99),
+      "makespan_s": float(clock),
+      "failovers": int(router.failovers),
+      "migrated_requests": int(router.migrated_requests),
+      "rpc": router.router_counters(),
+      "final_states": router.states(),
+  }
+  rec["rpc"] = {k: rec["rpc"][k] for k in
+                ("rpc_retries", "rpc_timeouts", "child_restarts")}
+  if killer is not None:
+    rec["kills"] = int(killer.kills)
+    rec["kill_signal"] = "SIGKILL"
+  # Sweep CURRENT pids too: a breaker probe may have respawned a child
+  # since construction, and the zero-orphans headline must cover it.
+  pids = set(pids) | {rep.child_pid for rep in router.replicas
+                      if rep.child_pid is not None}
+  router.close()
+  orphans = 0
+  time.sleep(0.2)
+  for pid in pids:
+    if pid is None:
+      continue
+    try:
+      os.kill(pid, 0)
+      orphans += 1
+    except ProcessLookupError:
+      pass
+  rec["orphans_after"] = orphans
+  return rec, outputs
+
+
+def run_process(num_requests: int = 32, num_slots: int = 4,
+                chunk: int = 4, plen: int = 6, max_new: int = 8,
+                rate_hz: float = 200.0, kill_at_step: int = 6):
+  """Process-transport episode suite: N=1 baseline, N=2 fleet (the
+  real-scaling headline), N=2 + real SIGKILL mid-decode."""
+  epl.init()
+  r = np.random.RandomState(0)
+  vocab = PROCESS_FACTORY["kwargs"]["vocab_size"]
+  prompts = r.randint(0, vocab, (num_requests, plen)).astype(np.int32)
+  lens = np.full((num_requests,), max_new, int)
+  arrivals = chaos.poisson_trace(rate_hz, num_requests, seed=1)
+  single, base_out = _process_episode(
+      prompts, lens, arrivals, replicas=1, num_slots=num_slots,
+      chunk=chunk)
+  fleet, _ = _process_episode(
+      prompts, lens, arrivals, replicas=2, num_slots=num_slots,
+      chunk=chunk)
+  kill, kill_out = _process_episode(
+      prompts, lens, arrivals, replicas=2, num_slots=num_slots,
+      chunk=chunk, kill_at_step=kill_at_step)
+  lost = num_requests - kill["resolved"]
+  assert kill["served"] == num_requests, kill
+  assert set(kill_out) == set(base_out)
+  exact = all(np.array_equal(kill_out[i], base_out[i])
+              for i in kill_out)
+  scaling = fleet["tokens_per_s"] / max(single["tokens_per_s"], 1e-9)
+  host_cores = os.cpu_count() or 1
+  record = {
+      "metric": PROCESS_METRIC,
+      "backend": jax.devices()[0].platform,
+      "device_kind": jax.devices()[0].device_kind,
+      "config": {
+          "transport": "process",
+          "factory": PROCESS_FACTORY["kwargs"],
+          "num_requests": num_requests, "num_slots": num_slots,
+          "prefill_chunk": chunk, "plen": plen, "max_new": max_new,
+          "arrival_rate_hz": rate_hz, "kill_at_step": kill_at_step,
+      },
+      "host_cores": host_cores,
+      "single": single,
+      "fleet": fleet,
+      "kill": kill,
+      "lost_requests": int(lost),
+      "bit_exact_vs_fault_free": bool(exact),
+      "tokens_per_s_scaling": scaling,
+      "scaling_target": 1.2,
+      # Honesty: process replicas only multiply throughput when the
+      # host has cores to run them on; a 1-core box time-slices and
+      # ~1.0x is the truthful reading there, not a regression.
+      "scaling_meets_target": bool(scaling > 1.2),
+      "scaling_note": (
+          f"{host_cores} host core(s): process replicas "
+          + ("can scale; target >1.2x applies"
+             if host_cores >= 2 else
+             "time-slice one core; ~1.0x expected — rerun on a "
+             ">=2-core box for the scaling headline")),
+      "orphans_after": (single["orphans_after"] + fleet["orphans_after"]
+                        + kill["orphans_after"]),
+  }
+  from easyparallellibrary_tpu.utils import bench_evidence
+  bench_evidence.append_record(record)
+  print(json.dumps(record))
+  assert lost == 0, f"{lost} request(s) lost in the SIGKILL episode"
+  assert exact, "SIGKILL failover streams diverged from fault-free"
+  assert record["orphans_after"] == 0, "orphan child processes leaked"
+  return record
+
+
 if __name__ == "__main__":
-  run()
+  if "--transport" in sys.argv:
+    kind = sys.argv[sys.argv.index("--transport") + 1]
+    if kind != "process":
+      raise SystemExit(f"unknown --transport {kind!r}")
+    run_process()
+  else:
+    run()

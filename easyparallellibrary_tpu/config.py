@@ -513,6 +513,29 @@ class ServingConfig(_Category):
       # to the replica that served it last (warm KV / prefix-cache
       # locality), load permitting.  Off = pure least-loaded.
       "router.affinity": True,
+      # --- replica transports (serving/transport.py, docs/serving.md
+      # "Replica transports").  "inproc" (default) hosts replicas in
+      # the router's process, byte-for-byte the PR-8 behavior;
+      # "process" spawns each replica as a subprocess owning its own
+      # JAX runtime (the REAL fault domain: SIGKILL-survivable
+      # failover via the router-side journal, wire-level timeouts,
+      # idempotent retries).  Process mode needs a Router(factory=...)
+      # spec ("module:attr" building (model, params) in the child).
+      "router.transport": "inproc",
+      # Per-RPC wire deadline.  Generous by default — a child's first
+      # step carries XLA compilation; chaos tests tighten it.  A STEP
+      # that misses the deadline condemns the replica (fenced with
+      # SIGKILL at evacuation) because steps are not idempotent.
+      "router.rpc_timeout_s": 30.0,
+      # Idempotent-call retries (submit/restore/cancel/snapshot) after
+      # the first attempt, with jittered exponential backoff from
+      # rpc_backoff_s.  Retried submits cannot double-admit: the child
+      # dedups by uid.
+      "router.rpc_retries": 2,
+      "router.rpc_backoff_s": 0.05,
+      # Deadline for a spawned child to import JAX, build its engine
+      # from the factory, and answer the init frame.
+      "router.spawn_timeout_s": 120.0,
   }
 
   @property
@@ -849,6 +872,22 @@ class Config:
           f"(a replica cannot go down before it goes suspect); got "
           f"suspect_after={router.suspect_after}, "
           f"down_after={router.down_after}")
+    if router.transport not in ("inproc", "process"):
+      raise ValueError(
+          f"serving.router.transport must be 'inproc' or 'process'; "
+          f"got {router.transport!r}")
+    if router.rpc_timeout_s <= 0:
+      raise ValueError(f"serving.router.rpc_timeout_s must be > 0; "
+                       f"got {router.rpc_timeout_s}")
+    if router.rpc_retries < 0:
+      raise ValueError(f"serving.router.rpc_retries must be >= 0; "
+                       f"got {router.rpc_retries}")
+    if router.rpc_backoff_s < 0:
+      raise ValueError(f"serving.router.rpc_backoff_s must be >= 0; "
+                       f"got {router.rpc_backoff_s}")
+    if router.spawn_timeout_s <= 0:
+      raise ValueError(f"serving.router.spawn_timeout_s must be > 0; "
+                       f"got {router.spawn_timeout_s}")
     if router.drain_timeout_s < 0:
       raise ValueError(f"serving.router.drain_timeout_s must be >= 0 "
                        f"(0 = migrate immediately); got "

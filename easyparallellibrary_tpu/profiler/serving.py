@@ -338,6 +338,53 @@ class ServingStats:
     when it finishes a ``run()`` drive with a registry attached."""
     registry.publish(step, self.summary(), "serving")
 
+  # ----------------------------------------------------- wire round trip
+
+  _STATE_SCALARS = (
+      "steps", "busy_time_s", "prefill_tokens", "decode_tokens",
+      "finished_requests", "generated_tokens", "drafted_tokens",
+      "accepted_tokens", "shed_requests", "requeues", "bad_steps",
+      "step_retries", "degraded_transitions", "degraded_level",
+      "watchdog_timeouts", "recompiles", "kv_blocks_free",
+      "kv_blocks_used", "kv_fragmentation", "preemptions",
+      "proactive_preemptions", "itl_ewma_s")
+
+  def state_dict(self) -> Dict[str, Any]:
+    """JSON-serializable rollup state: every aggregate counter plus the
+    RAW latency/acceptance samples the fleet rollup re-ranks.  This is
+    how a process-hosted replica's stats cross the wire
+    (serving/transport.py): the parent loads the dict into a twin via
+    :meth:`load_state` and :func:`fleet_summary` merges it exactly like
+    an in-process replica's.  Per-request in-flight traces stay local —
+    only resolved aggregates travel."""
+    state: Dict[str, Any] = {k: getattr(self, k)
+                             for k in self._STATE_SCALARS}
+    state["occupancy_sum"] = float(self._occupancy_sum)
+    state["accepted_per_step"] = list(self._accepted_per_step)
+    state["finish_reasons"] = dict(self.finish_reasons)
+    state["ttft_samples"] = self.ttft_samples()
+    state["itl_samples"] = self.itl_samples()
+    return state
+
+  def load_state(self, state: Dict[str, Any]) -> None:
+    """Adopt a :meth:`state_dict` wholesale (resets first).  The
+    reservoirs are refilled in sample order — at or below the cap the
+    contents are identical to the source's, which is all the rollup
+    reads."""
+    self.reset()
+    for k in self._STATE_SCALARS:
+      if k in state:
+        setattr(self, k, type(getattr(self, k))(state[k]))
+    self._occupancy_sum = float(state.get("occupancy_sum", 0.0))
+    self._accepted_per_step = [float(x) for x in
+                               state.get("accepted_per_step", ())]
+    self.finish_reasons = {str(k): int(v) for k, v in
+                           (state.get("finish_reasons") or {}).items()}
+    for x in state.get("ttft_samples", ()):
+      self._ttft_res.add(float(x))
+    for x in state.get("itl_samples", ()):
+      self._itl_res.add(float(x))
+
   def summary(self) -> Dict[str, float]:
     ttfts, itls = self._ttfts(), self._itls()
     busy = max(self.busy_time_s, 1e-9)

@@ -27,6 +27,10 @@ from easyparallellibrary_tpu.serving.resilience import (
 )
 from easyparallellibrary_tpu.serving.replica import EngineReplica
 from easyparallellibrary_tpu.serving.router import Router
+from easyparallellibrary_tpu.serving.transport import (
+    InprocTransport, ProcessTransport, RemoteError, ReplicaDeadError,
+    ReplicaTransport, TransportError, TransportTimeout,
+)
 from easyparallellibrary_tpu.serving.kv_cache import (
     NULL_BLOCK, BlockAllocator, SlotAllocator, allocate_kv_cache,
     allocate_paged_kv_cache, blocks_per_slot, cache_bytes, cache_length,
@@ -52,6 +56,8 @@ __all__ = [
     "AdmissionController", "BadStepPolicy", "DEGRADE_LEVELS",
     "FINISH_REASONS", "PRIORITIES",
     "EngineReplica", "HEALTH_STATES", "ReplicaHealth", "Router",
+    "InprocTransport", "ProcessTransport", "RemoteError", "ReplicaDeadError",
+    "ReplicaTransport", "TransportError", "TransportTimeout",
     "Drafter", "DraftModelDrafter", "NgramDrafter", "ngram_propose",
     "verify_tokens",
 ]

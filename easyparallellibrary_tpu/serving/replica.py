@@ -26,17 +26,21 @@ can hold the same reference).  On top of the engine this class adds:
   :meth:`restore_request` / :meth:`evacuate` delegate to the engine's
   bit-exact prefix-replay machinery (scheduler.snapshot_requests).
 
-Thread-hosting note: the router drives replicas synchronously (one
-``step()`` sweep per router step) — deterministic, test-friendly, and
-faithful to the failure modes that matter (a step that raises models a
-dead process: its HOST state is what a control plane could recover from
-a request journal; a step that stalls models a hung device).  Nothing
-here holds state that would prevent moving a replica behind a thread or
-process boundary later — the snapshot currency is already serializable.
+Hosting note: by default the router drives replicas in-process and
+synchronously (one ``step()`` sweep per router step — deterministic and
+test-friendly), via :class:`serving.transport.InprocTransport`.  With
+``serving.router.transport = "process"`` the SAME class runs inside a
+spawned worker process that owns its own JAX runtime — the
+:func:`replica_worker_main` serve loop at the bottom of this module
+answers the parent's :class:`serving.transport.ProcessTransport` over a
+length-prefixed-JSON socketpair, which is the real fault domain: a
+SIGKILL takes exactly one replica's memory, and failover recovers from
+the router-side journal, not from this process.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional
 
 from easyparallellibrary_tpu.serving.engine import ContinuousBatchingEngine
@@ -177,3 +181,228 @@ class EngineReplica:
   def __repr__(self):
     return (f"EngineReplica({self.index}, active={self.num_active}, "
             f"queued={self.queue_depth})")
+
+
+# ---------------------------------------------------------- worker main --
+#
+# `python -m easyparallellibrary_tpu.serving.replica --worker-fd N` is
+# the child half of serving/transport.py's ProcessTransport: a spawned
+# process owning its own JAX runtime, answering length-prefixed JSON
+# frames over the socketpair fd it inherited.  Pure host plumbing — the
+# engine underneath is byte-for-byte the in-process one.
+
+
+def _install_pdeathsig() -> None:
+  """Ask Linux to SIGKILL this worker the instant its parent dies
+  (PR_SET_PDEATHSIG) — the kernel-level half of orphan prevention; the
+  pipe-EOF exit below is the portable half."""
+  try:
+    import ctypes
+    libc = ctypes.CDLL("libc.so.6", use_errno=True)
+    PR_SET_PDEATHSIG = 1
+    libc.prctl(PR_SET_PDEATHSIG, 9)  # SIGKILL
+  except Exception:  # pragma: no cover - non-Linux / no libc
+    pass
+
+
+class _WorkerServer:
+  """Dispatch loop state for one worker process."""
+
+  def __init__(self, sock):
+    from easyparallellibrary_tpu.serving import transport as transport_lib
+    self._t = transport_lib
+    self.sock = sock
+    self.reader = transport_lib.FrameReader(sock)
+    self.replica: Optional[EngineReplica] = None
+    self._first_tokens: List[Any] = []
+    # Idempotency dedup: uid -> recorded reply result.  A submit or
+    # restore retried after an ambiguous timeout (the reply was lost
+    # AFTER this process applied the call) returns the recorded
+    # verdict instead of admitting the request twice.
+    self._applied: Dict[Any, Dict[str, Any]] = {}
+
+  # ------------------------------------------------------------- handlers
+
+  def _beat(self) -> Dict[str, Any]:
+    rep = self.replica
+    if rep is None:
+      return {}
+    try:
+      compiles = int(rep.engine._step_fn._cache_size())
+    except Exception:
+      compiles = 0
+    return {
+        "watchdog_timeouts": int(rep.watchdog_timeouts),
+        "bad_steps": int(rep.bad_steps),
+        "itl_ewma_s": float(rep.itl_ewma_s),
+        "queue_depth": int(rep.queue_depth),
+        "num_active": int(rep.num_active),
+        "num_slots": int(rep.num_slots),
+        "load": int(rep.load),
+        "has_work": bool(rep.has_work),
+        "compiles": compiles,
+        "pid": os.getpid(),
+    }
+
+  def do_init(self, p: Dict[str, Any]) -> Dict[str, Any]:
+    wire = int(p.get("wire_version", -1))
+    if wire != self._t.WIRE_VERSION:
+      raise ValueError(
+          f"wire version mismatch: parent speaks v{wire}, this worker "
+          f"speaks v{self._t.WIRE_VERSION} — parent and child must run "
+          f"the same build")
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+      # Mirrors tests/conftest.py: the image's sitecustomize can latch
+      # the TPU plugin before env vars are honored; backends are not
+      # initialized yet, so the config override still wins.
+      jax.config.update("jax_platforms", "cpu")
+    import easyparallellibrary_tpu as epl
+    config = epl.Config(p.get("config") or {})
+    epl.init(config)
+    fn, kwargs = self._t.resolve_factory(p["factory"])
+    model, params = fn(**kwargs)
+    self.replica = EngineReplica(
+        int(p.get("index", 0)), model, params, config=config,
+        **(p.get("engine_kwargs") or {}))
+    self.replica.engine.scheduler.on_first_token.append(
+        self._first_tokens.append)
+    return {"pid": os.getpid(),
+            "platform": jax.devices()[0].platform}
+
+  def do_submit(self, p: Dict[str, Any]) -> Dict[str, Any]:
+    req = Request.restore(p["snap"])
+    if req.uid in self._applied:
+      return self._applied[req.uid]
+    accepted = self.replica.submit(req)
+    result: Dict[str, Any] = {"accepted": bool(accepted)}
+    if not accepted:
+      fin = self.replica.finished.get(req.uid)
+      if fin is not None:
+        result["finished"] = self._t.encode_finished(fin)
+    self._applied[req.uid] = result
+    return result
+
+  def do_restore(self, p: Dict[str, Any]) -> Dict[str, Any]:
+    uid = p["snap"]["request"]["uid"]
+    if uid in self._applied and self._applied[uid].get("restored"):
+      return self._applied[uid]
+    self.replica.restore_request(p["snap"], front=bool(p.get("front")))
+    result = {"accepted": True, "restored": True, "uid": uid}
+    self._applied[uid] = result
+    return result
+
+  def do_cancel(self, p: Dict[str, Any]) -> Dict[str, Any]:
+    return {"cancelled": bool(self.replica.cancel(p["uid"]))}
+
+  def do_step(self, p: Dict[str, Any]) -> Dict[str, Any]:
+    acked = {uid: int(n) for uid, n in p.get("acked", ())}
+    fins = self.replica.step()
+    progress = []
+    order = []
+    for uid, gen in self.replica.engine.scheduler.progress():
+      order.append(uid)
+      start = min(acked.get(uid, 0), len(gen))
+      progress.append([uid, start, [int(t) for t in gen[start:]]])
+    # A finished request frees its dedup slot — uids may be reused
+    # across episodes, and the dedup map must not grow unboundedly.
+    for fin in fins:
+      self._applied.pop(fin.uid, None)
+    # Shed verdicts free at the NEXT step: the parent is synchronous —
+    # by the time it sends a step, every earlier submit's retry loop
+    # has resolved — so the retry window is over, and keeping the
+    # verdict would permanently reject a legitimately reused uid (and
+    # leak one entry per shed under sustained overload).
+    for uid in [u for u, v in self._applied.items()
+                if not v.get("accepted")]:
+      self._applied.pop(uid, None)
+    # Drain IN PLACE: the scheduler hook holds this exact list object.
+    first = list(self._first_tokens)
+    self._first_tokens.clear()
+    return {"finished": [self._t.encode_finished(f) for f in fins],
+            "progress": progress, "order": order, "first": first}
+
+  def do_snapshot(self, p: Dict[str, Any]) -> Dict[str, Any]:
+    return {"snaps": self.replica.snapshot_requests()}
+
+  def do_evacuate(self, p: Dict[str, Any]) -> Dict[str, Any]:
+    snaps = self.replica.evacuate()
+    for snap in snaps:
+      self._applied.pop(snap["request"]["uid"], None)
+    return {"snaps": snaps}
+
+  def do_stats(self, p: Dict[str, Any]) -> Dict[str, Any]:
+    stats = self.replica.stats
+    return {"stats": stats.state_dict() if stats is not None else None}
+
+  def do_ping(self, p: Dict[str, Any]) -> Dict[str, Any]:
+    return {"pong": True}
+
+  # ----------------------------------------------------------- serve loop
+
+  def serve(self) -> int:
+    handlers = {
+        "init": self.do_init, "submit": self.do_submit,
+        "restore": self.do_restore, "cancel": self.do_cancel,
+        "step": self.do_step, "snapshot": self.do_snapshot,
+        "evacuate": self.do_evacuate, "stats": self.do_stats,
+        "ping": self.do_ping,
+    }
+    while True:
+      try:
+        frame = self.reader.read(None)
+      except self._t.ReplicaDeadError:
+        # Parent gone (pipe EOF): exit now rather than orphan — the
+        # prctl death signal is the backstop, this is the portable path.
+        break
+      rid, method = frame.get("id"), frame.get("m")
+      if method == "shutdown":
+        self._reply(rid, method, {"ok": True, "result": {}})
+        break
+      handler = handlers.get(method)
+      try:
+        if handler is None:
+          raise ValueError(f"unknown transport method {method!r}")
+        result = handler(frame.get("p") or {})
+        self._reply(rid, method, {"ok": True, "result": result})
+      except Exception as e:  # noqa: BLE001 — report, don't die: the
+        # parent decides whether an error is fatal (its router treats a
+        # step error as replica death and evacuates gracefully).
+        self._reply(rid, method,
+                    {"ok": False, "error": str(e),
+                     "etype": type(e).__name__})
+    if self.replica is not None:
+      self.replica.close()
+    return 0
+
+  def _reply(self, rid, method, body: Dict[str, Any]) -> None:
+    body["id"] = rid
+    body["m"] = method
+    body["beat"] = self._beat()
+    try:
+      self._t.send_frame(self.sock, body)
+    except OSError:
+      raise self._t.ReplicaDeadError("parent went away mid-reply")
+
+
+def replica_worker_main(fd: int) -> int:
+  """Entry point for the spawned replica worker (transport child)."""
+  _install_pdeathsig()
+  import socket as socket_lib
+  sock = socket_lib.socket(fileno=fd)
+  try:
+    return _WorkerServer(sock).serve()
+  finally:
+    try:
+      sock.close()
+    except OSError:
+      pass
+
+
+if __name__ == "__main__":
+  import argparse
+  parser = argparse.ArgumentParser(
+      description="serving replica worker (spawned by ProcessTransport; "
+                  "not a user-facing CLI)")
+  parser.add_argument("--worker-fd", type=int, required=True)
+  raise SystemExit(replica_worker_main(parser.parse_args().worker_fd))

@@ -375,6 +375,19 @@ class ReplicaHealth:
     if self.state == "suspect":
       self._set_state("healthy", "clean beat")
 
+  def beat_from_wire(self, beat: Dict[str, "object"]) -> None:
+    """Ingest a transport heartbeat (serving/transport.py): process
+    replicas piggyback their watchdog/bad-step WATERMARKS, the ITL EWMA
+    and load signals on every RPC reply, and the router feeds the
+    health half here.  Same cumulative-counter semantics as
+    :meth:`beat` — the dict is just the wire spelling of the in-process
+    signals, so the state machine cannot tell (and must not care)
+    which side of a process boundary the replica lives on."""
+    self.beat(
+        watchdog_timeouts=int(beat.get("watchdog_timeouts", 0) or 0),
+        bad_steps=int(beat.get("bad_steps", 0) or 0),
+        itl_s=float(beat.get("itl_ewma_s", 0.0) or 0.0))
+
   def touch(self, now: Optional[float] = None) -> None:
     """Reset the heartbeat clock WITHOUT a step.  The router calls this
     for an IDLE replica at dispatch time: an idle replica's loop is not
@@ -438,6 +451,23 @@ class ReplicaHealth:
     self.last_beat = self.clock()   # fresh grace period, not instant-stale
     self._set_state("healthy", "rejoin")
     return True
+
+  def probe_failed(self, reason: str = "") -> None:
+    """A half-open probe could not even START the replica (e.g. a
+    process transport's respawn failed).  Re-arm the breaker as if the
+    replica had relapsed — trip count up, cooldown window restarted —
+    so a host that cannot spawn is backed off exponentially instead of
+    spawn-stormed every sweep."""
+    if self.state != "down":
+      return
+    self.trips += 1
+    self._down_since = self.clock()
+    if reason:
+      self.down_reason = reason
+    get_logger().warning(
+        "replica probe failed%s: breaker re-armed (trip %d, hold-out "
+        "%.1fs)", f" ({reason})" if reason else "", self.trips,
+        self.cooldown_s())
 
   def note_stable(self) -> None:
     """Forgive one breaker trip (the router calls this after a rejoined

@@ -46,9 +46,22 @@ forks it) — and the fleet rollup (``serving/fleet/*``,
 without double counting.
 
 Everything is driven synchronously: one :meth:`step` sweeps every live
-replica (an idle replica's step is just a heartbeat).  See
-docs/serving.md "Multi-replica serving"; ``make chaos-router`` is the
-acceptance harness.
+replica (an idle replica's step is just a heartbeat).
+
+**Transports** (serving/transport.py): replicas sit behind the
+:class:`ReplicaTransport` seam.  The default ``inproc`` transport hosts
+them in this process, byte-for-byte the original behavior; the
+``process`` transport hosts each replica in a spawned subprocess owning
+its own JAX runtime — the real fault domain.  The router's step is
+two-phase (dispatch to every process replica, then collect) so
+concurrent children overlap their sweeps, health beats arrive as wire
+watermarks, and a dead child's requests are recovered from the
+transport's parent-side journal — no RPC to the corpse — and replayed
+bit-exactly onto survivors through the same prefix-replay path.
+
+See docs/serving.md "Multi-replica serving" / "Replica transports";
+``make chaos-router`` and ``make chaos-proc`` are the acceptance
+harnesses.
 """
 
 from __future__ import annotations
@@ -71,6 +84,8 @@ from easyparallellibrary_tpu.serving.replica import EngineReplica
 from easyparallellibrary_tpu.serving.resilience import ReplicaHealth
 from easyparallellibrary_tpu.serving.scheduler import (
     FinishedRequest, Request, next_flow_id)
+from easyparallellibrary_tpu.serving.transport import (
+    InprocTransport, ProcessTransport, TransportError)
 from easyparallellibrary_tpu.utils.logging import get_logger
 
 # Prompt tokens hashed for prefix-affinity routing: long enough to
@@ -104,7 +119,8 @@ class Router:
 
   def __init__(self, model=None, params=None, *, num_replicas=None,
                mesh=None, registry=None, config=None,
-               clock=time.monotonic, replicas=None, **engine_kwargs):
+               clock=time.monotonic, replicas=None, factory=None,
+               transport=None, **engine_kwargs):
     root_config = config if config is not None else Env.get().config
     rconf = root_config.serving.router
     self._drain_timeout_s = rconf.drain_timeout_s
@@ -117,16 +133,36 @@ class Router:
     # as one deployment, not N replica streams after the fact.
     self._slo = slo_lib.ensure_configured(root_config)
     self._last_rollup = clock()
+    self.transport = (transport if transport is not None
+                      else rconf.transport)
     if replicas is not None:
       self.replicas: List[EngineReplica] = list(replicas)
+      self.transport = "injected"
     else:
       n = num_replicas if num_replicas is not None else rconf.replicas
       if n < 1:
         raise ValueError(f"num_replicas must be >= 1: {n}")
-      self.replicas = [
-          EngineReplica(i, model, params, mesh=mesh, registry=registry,
-                        config=root_config, **engine_kwargs)
-          for i in range(n)]
+      if self.transport == "process":
+        # Process-isolated replicas (serving/transport.py): each child
+        # builds (model, params) from `factory` inside its OWN JAX
+        # runtime — live arrays never cross the wire, and a SIGKILL
+        # takes exactly one replica's memory.
+        if factory is None:
+          raise ValueError(
+              "serving.router.transport='process' needs Router("
+              "factory=...): a 'module:attr' spec (or module-level "
+              "callable) building (model, params) in the child — live "
+              "model/params objects cannot cross a process boundary")
+        self.replicas = [
+            ProcessTransport(i, factory, config=root_config,
+                             engine_kwargs=engine_kwargs)
+            for i in range(n)]
+      else:
+        self.replicas = [
+            InprocTransport(i, model, params, mesh=mesh,
+                            registry=registry, config=root_config,
+                            **engine_kwargs)
+            for i in range(n)]
     itl_slo = root_config.serving.resilience.itl_slo_s
     self.health: List[ReplicaHealth] = [
         ReplicaHealth(
@@ -234,11 +270,37 @@ class Router:
     idx = min(routable, key=lambda i: (self.replicas[i].load, i))
     return idx, "least_loaded"
 
+  def _shed_at_router(self, request: Request, prompt: np.ndarray,
+                      tracer) -> bool:
+    self.router_shed += 1
+    self.finished[request.uid] = FinishedRequest(
+        uid=request.uid, tokens=prompt, new_tokens=0,
+        finish_reason="shed")
+    if tracer.enabled:
+      tracer.instant(
+          "serving/route", cat="serving", track="serving/requests",
+          args={"uid": str(request.uid), "replica": -1,
+                "reason": "no_replica"})
+      tracer.flow("f", request.flow_id, track="serving/requests",
+                  args={"uid": str(request.uid), "reason": "shed"})
+    get_logger().warning(
+        "router shedding request %r: no routable replica (states %s)",
+        request.uid, self.states())
+    return False
+
   def submit(self, request: Request) -> bool:
     """Route and enqueue one request; False when it was shed — by the
     router (no routable replica) or by the chosen replica's admission
     control.  Either way the shed record lands in :attr:`finished` with
-    reason ``"shed"``, exactly once."""
+    reason ``"shed"``, exactly once.
+
+    A replica that DIES during the submit (a process transport's child
+    crashed or timed out mid-call) is failed over on the spot, and the
+    request is admitted exactly once regardless of where the call was
+    lost: the transport journals the request BEFORE the RPC, so an
+    ambiguous submit rides the failover replay to a survivor, and
+    child-side uid dedup stops a retried wire call from double
+    admitting."""
     prompt = np.asarray(request.prompt, np.int32).reshape(-1)
     # The trace-context id is minted HERE — the earliest point the
     # request touches the fleet — so its flow arc starts at routing and
@@ -246,46 +308,53 @@ class Router:
     # failover, and retirement (docs/observability.md).
     if request.flow_id is None:
       request = dataclasses.replace(request, flow_id=next_flow_id())
-    idx, reason = self._choose(prompt)
     tracer = trace_lib.get_tracer()
     if tracer.enabled:
       tracer.flow("s", request.flow_id, track="serving/requests",
                   args={"uid": str(request.uid)})
-    if idx is None:
-      self.router_shed += 1
-      self.finished[request.uid] = FinishedRequest(
-          uid=request.uid, tokens=prompt, new_tokens=0,
-          finish_reason="shed")
+    for _attempt in range(len(self.replicas) + 1):
+      idx, reason = self._choose(prompt)
+      if idx is None:
+        return self._shed_at_router(request, prompt, tracer)
       if tracer.enabled:
         tracer.instant(
             "serving/route", cat="serving", track="serving/requests",
-            args={"uid": str(request.uid), "replica": -1,
-                  "reason": "no_replica"})
-        tracer.flow("f", request.flow_id, track="serving/requests",
-                    args={"uid": str(request.uid), "reason": "shed"})
-      get_logger().warning(
-          "router shedding request %r: no routable replica (states %s)",
-          request.uid, self.states())
-      return False
-    if tracer.enabled:
-      tracer.instant(
-          "serving/route", cat="serving", track="serving/requests",
-          args={"uid": str(request.uid), "replica": idx,
-                "reason": reason})
-    accepted = self.replicas[idx].submit(request)
-    if accepted:
-      self.placement[request.uid] = idx
-      if self._affinity_enabled:
-        self._remember_affinity(self._prefix_hash(prompt), idx)
-    else:
-      # The replica's admission control shed it and recorded the
-      # resolution in ITS finished map; mirror fleet-side so callers
-      # never chase per-replica maps (the replica counted the shed —
-      # don't count it again here).
-      fin = self.replicas[idx].finished.get(request.uid)
-      if fin is not None:
-        self.finished[request.uid] = fin
-    return accepted
+            args={"uid": str(request.uid), "replica": idx,
+                  "reason": reason})
+      try:
+        accepted = self.replicas[idx].submit(request)
+      except TransportError as e:
+        # ONLY transport failures read as replica death here — a
+        # client error (malformed request -> ValueError) propagates to
+        # the caller exactly as the engine contract promises, and must
+        # never cost a healthy replica (let alone cascade fleet-wide).
+        get_logger().error(
+            "replica %d died during submit of %r (%s: %s); failing over",
+            idx, request.uid, type(e).__name__, e)
+        self.health[idx].mark_down(f"submit raised {type(e).__name__}")
+        self._failover(idx)
+        if request.uid in self.placement or self._parked_uid(request.uid):
+          # The transport journaled the ambiguous submit; the failover
+          # (or parking) above already owns it — admitted exactly once.
+          return True
+        continue
+      if accepted:
+        self.placement[request.uid] = idx
+        if self._affinity_enabled:
+          self._remember_affinity(self._prefix_hash(prompt), idx)
+      else:
+        # The replica's admission control shed it and recorded the
+        # resolution in ITS finished map; mirror fleet-side so callers
+        # never chase per-replica maps (the replica counted the shed —
+        # don't count it again here).
+        fin = self.replicas[idx].finished.get(request.uid)
+        if fin is not None:
+          self.finished[request.uid] = fin
+      return accepted
+    return self._shed_at_router(request, prompt, tracer)
+
+  def _parked_uid(self, uid: Any) -> bool:
+    return any(snap["request"]["uid"] == uid for snap in self._parked)
 
   def cancel(self, uid: Any) -> bool:
     """Cancel ``uid`` wherever it lives — on its replica, or in the
@@ -312,10 +381,25 @@ class Router:
         return True
     idx = self.placement.get(uid)
     if idx is not None:
-      return self.replicas[idx].cancel(uid)
+      try:
+        return self.replicas[idx].cancel(uid)
+      except TransportError as e:
+        # The replica died holding the request: fail it over (fence +
+        # journal), then cancel it wherever it landed — parked or on a
+        # survivor.  A cancellation must never be silently lost to a
+        # later failover replay decoding the request to completion.
+        get_logger().error(
+            "replica %d died during cancel of %r (%s: %s); failing over",
+            idx, uid, type(e).__name__, e)
+        self.health[idx].mark_down(f"cancel raised {type(e).__name__}")
+        self._failover(idx)
+        return self.cancel(uid)
     for rep in self.replicas:
-      if rep.cancel(uid):
-        return True
+      try:
+        if rep.cancel(uid):
+          return True
+      except TransportError:
+        continue
     return False
 
   # --------------------------------------------------------------- step
@@ -334,26 +418,47 @@ class Router:
     out: List[FinishedRequest] = []
     self._check_drains(now)
     self._flush_parked()
+    # Phase 1 — dispatch: process transports get their step frame NOW,
+    # so concurrent children overlap their sweeps (fleet wall-clock =
+    # the slowest child, not the sum); in-process replicas compute at
+    # collect time below, preserving the PR-8 execution order exactly.
+    stepped: List[int] = []
     for i, rep in enumerate(self.replicas):
       h = self.health[i]
       if h.state == "down":
         if h.can_probe(now):
           self._probe(i)
         continue
+      send = getattr(rep, "step_send", None)
+      if send is not None:
+        try:
+          send()
+        except Exception as e:  # noqa: BLE001 — dead at dispatch
+          self._note_step_death(i, e)
+          continue
+      stepped.append(i)
+    # Phase 2 — collect (and run, for in-process replicas), in replica
+    # order: retirements, health beats, failover of anything that died.
+    for i in stepped:
+      rep = self.replicas[i]
+      h = self.health[i]
+      recv = getattr(rep, "step_recv", None)
       try:
-        fins = rep.step()
+        fins = rep.step() if recv is None else recv()
       except Exception as e:  # noqa: BLE001 — ANY escaping error = dead
-        get_logger().error(
-            "replica %d died mid-step (%s: %s); failing over",
-            i, type(e).__name__, e)
-        h.mark_down(f"step raised {type(e).__name__}")
-        self._failover(i)
+        self._note_step_death(i, e)
         continue
       for fin in fins:
         self._note_finished(i, fin)
         out.append(fin)
-      h.beat(watchdog_timeouts=rep.watchdog_timeouts,
-             bad_steps=rep.bad_steps, itl_s=rep.itl_ewma_s)
+      wire = getattr(rep, "wire_beat", None)
+      if wire:
+        # Process replica: the beat dict rode the step reply over the
+        # wire; same watermark semantics as the in-process signals.
+        h.beat_from_wire(wire)
+      else:
+        h.beat(watchdog_timeouts=rep.watchdog_timeouts,
+               bad_steps=rep.bad_steps, itl_s=rep.itl_ewma_s)
       if h.state == "healthy" and h.trips:
         # Breaker forgiveness: a rejoined replica that survives a full
         # cooldown window clean sheds one trip.
@@ -440,6 +545,35 @@ class Router:
 
   # ----------------------------------------------------------- failover
 
+  def _note_step_death(self, index: int, exc: BaseException) -> None:
+    """One replica's step (dispatch or collect) raised: mark it down,
+    emit the ``serving/replica_down`` incident instant — carrying the
+    child's kill signal when the transport reaped one, so PR 9's SLO
+    monitor and diagnostic bundles see REAL process incidents — and
+    fail its requests over."""
+    rep = self.replicas[index]
+    sig = getattr(rep, "exit_signal", None)
+    sig_name = ""
+    if sig:
+      try:
+        import signal as _signal
+        sig_name = _signal.Signals(sig).name
+      except (ValueError, ImportError):
+        sig_name = str(sig)
+    get_logger().error(
+        "replica %d died mid-step (%s: %s%s); failing over",
+        index, type(exc).__name__, exc,
+        f"; child exit signal {sig_name}" if sig_name else "")
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.instant(
+          "serving/replica_down", cat="serving", track="serving",
+          args={"replica": index, "error": type(exc).__name__,
+                "signal": sig_name,
+                "pid": getattr(rep, "child_pid", None) or -1})
+    self.health[index].mark_down(f"step raised {type(exc).__name__}")
+    self._failover(index)
+
   def _survivors(self, exclude: int) -> List[int]:
     """Failover targets: healthy first; a draining replica is never a
     target (it is trying to empty), a suspect one only as last resort
@@ -455,11 +589,45 @@ class Router:
     """Distribute snapshots over ``targets`` (least-loaded each time,
     re-ranked as restores land).  Restores go to the queue FRONT in
     reverse snapshot order, so the dead replica's service order is
-    preserved on each target.  Returns how many were placed."""
+    preserved on each target.  Returns how many were placed.
+
+    A target that DIES mid-placement must not take the remaining
+    snapshots with it ("an outage delays, it never loses"): the dead
+    target is dropped and marked down, an AMBIGUOUSLY-applied restore
+    (the target's transport journaled it before the wire failed) stays
+    placed there — its own failover recovers it, double-placing would
+    fork the request — and when no target is left the remainder parks."""
     placed = 0
-    for snap in reversed(snaps):
+    targets = list(targets)
+    pending = list(snaps)
+    while pending:
+      if not targets:
+        get_logger().warning(
+            "placement ran out of targets: parking %d remaining "
+            "request(s)", len(pending))
+        self._parked.extend(pending)
+        break
+      snap = pending[-1]
       idx = min(targets, key=lambda i: (self.replicas[i].load, i))
-      uid = self.replicas[idx].restore_request(snap, front=True)
+      try:
+        uid = self.replicas[idx].restore_request(snap, front=True)
+      except Exception as e:  # noqa: BLE001 — target died mid-restore
+        get_logger().error(
+            "replica %d died during restore placement (%s: %s)",
+            idx, type(e).__name__, e)
+        self.health[idx].mark_down(f"restore raised {type(e).__name__}")
+        targets.remove(idx)
+        owns = getattr(self.replicas[idx], "owns", None)
+        if owns is not None and owns(snap["request"]["uid"]):
+          # Ambiguous outcome, journaled on the dead target: fail THAT
+          # replica over NOW (fence first, so it cannot also serve the
+          # request) — its journal re-places the snapshot on a live
+          # survivor or parks it.  Leaving it for a later sweep would
+          # strand it: run() does not drive down replicas.
+          pending.pop()
+          self._failover(idx)
+        continue
+      pending.pop()
       self.placement[uid] = idx
       placed += 1
     return placed
@@ -519,7 +687,12 @@ class Router:
 
   def _probe(self, index: int) -> None:
     """Half-open breaker probe: the cooldown elapsed, let the replica
-    serve again; a relapse re-trips with a doubled hold-out."""
+    serve again; a relapse re-trips with a doubled hold-out.  A process
+    replica's child is respawned first (cold engine: fresh compile,
+    empty cache — what a real restart costs); a failed respawn re-arms
+    the breaker with its doubled hold-out instead of spawn-storming."""
+    if not self._ensure_replica_host(index):
+      return
     if self.health[index].rejoin():
       self.probes += 1
       self._rejoined_at[index] = self.clock()
@@ -527,6 +700,27 @@ class Router:
           "probing replica %d back into service (trip %d, next "
           "hold-out %.1fs)", index, self.health[index].trips,
           self.health[index].cooldown_s())
+
+  def _ensure_replica_host(self, index: int) -> bool:
+    """(Re)start a transport-hosted replica's process if it is gone;
+    True when the replica is usable.  In-process replicas are always
+    up (their ``ensure_started`` is a no-op)."""
+    rep = self.replicas[index]
+    ensure = getattr(rep, "ensure_started", None)
+    if ensure is None:
+      return True
+    try:
+      if ensure():
+        get_logger().info(
+            "replica %d: child respawned (restart %d)", index,
+            getattr(rep, "child_restarts", 0))
+    except Exception as e:  # noqa: BLE001 — spawn/init failed
+      get_logger().error(
+          "replica %d: respawn failed (%s: %s); breaker re-armed",
+          index, type(e).__name__, e)
+      self.health[index].probe_failed(f"respawn {type(e).__name__}")
+      return False
+    return True
 
   # ------------------------------------------------------ drain / rejoin
 
@@ -578,9 +772,16 @@ class Router:
         self._parked.extend(snaps)
 
   def rejoin(self, index: int, force: bool = False) -> bool:
-    """Return a drained (or down) replica to service, warm — its engine,
-    cache and compiled step were never torn down.  For a down replica
-    the circuit breaker must agree (``force=True`` overrides)."""
+    """Return a drained (or down) replica to service.  An in-process
+    replica rejoins warm — its engine, cache and compiled step were
+    never torn down; a process replica whose child died is respawned
+    (cold) first.  For a down replica the circuit breaker must agree
+    (``force=True`` overrides)."""
+    h = self.health[index]
+    if h.state == "down" and not (force or h.can_probe()):
+      return False
+    if not self._ensure_replica_host(index):
+      return False
     ok = self.health[index].rejoin(force=force)
     if ok:
       self._drain_deadline.pop(index, None)
@@ -592,7 +793,7 @@ class Router:
 
   def router_counters(self) -> Dict[str, float]:
     states = self.states()
-    return {
+    counters = {
         "failovers": float(self.failovers),
         "migrated_requests": float(self.migrated_requests),
         "router_shed": float(self.router_shed),
@@ -602,7 +803,23 @@ class Router:
         "replicas_suspect": float(states.count("suspect")),
         "replicas_down": float(states.count("down")),
         "replicas_draining": float(states.count("draining")),
+        # Transport-layer incident counters (serving/transport.py),
+        # summed fleet-wide: retried idempotent RPCs, wire deadline
+        # misses, and child respawns.  They ride the fleet rollup
+        # through MetricRegistry.namespaced like every other counter,
+        # so the SLO monitor and diagnostic bundles see real-process
+        # incidents with zero new plumbing.  All 0 on inproc fleets.
+        "rpc_retries": 0.0,
+        "rpc_timeouts": 0.0,
+        "child_restarts": 0.0,
     }
+    for rep in self.replicas:
+      rpc = getattr(rep, "rpc_counters", None)
+      if rpc is None:
+        continue
+      for key, val in rpc().items():
+        counters[key] = counters.get(key, 0.0) + float(val)
+    return counters
 
   def fleet_summary(self) -> Dict[str, float]:
     """One fleet-wide record (profiler.serving.fleet_summary): summed
@@ -610,9 +827,12 @@ class Router:
     plus the router's own counters.  Total fleet sheds =
     ``shed`` (replica admission control) + ``router_shed`` (nothing
     routable)."""
-    return fleet_summary([rep.stats for rep in self.replicas
-                          if rep.stats is not None],
-                         self.router_counters())
+    # Bind each stats ONCE: for a process replica the property is a
+    # blocking child RPC — evaluating it in both the filter and the
+    # value position would double every rollup's wire traffic.
+    stats = [s for s in (rep.stats for rep in self.replicas)
+             if s is not None]
+    return fleet_summary(stats, self.router_counters())
 
   def publish(self, registry, step: int) -> None:
     """Publish the rollup under ``serving/fleet/*`` (every replica's own

@@ -77,6 +77,13 @@ def next_flow_id() -> int:
   return next(_FLOW_IDS)
 
 
+# Request.snapshot() wire-format version: bump on ANY field change and
+# keep a reader for every prior version — snapshots cross process
+# boundaries (transport RPC, crash journals) where writer and reader
+# can be different builds.
+SNAPSHOT_VERSION = 1
+
+
 def _request_key(req: "Request") -> np.ndarray:
   """The request's private PRNG stream key.  Deterministic in
   ``seed``/``uid`` and stable across processes (crc32, not Python's
@@ -141,8 +148,16 @@ class Request:
     field of its own: the stream key derives deterministically from
     ``seed``/``uid`` (:func:`_request_key`) and is folded by committed
     token index, so prompt + generated prefix IS the full sampler
-    state."""
+    state.
+
+    The dict is **versioned** (``"v": 1``): snapshots cross process
+    boundaries (serving/transport.py ships them to worker processes and
+    journals them for crash recovery), so a future field change must
+    bump the version and keep a reader for v1 — :meth:`restore` rejects
+    unknown versions with a clear error instead of mis-restoring, and
+    tests/golden/request_snapshot_v1.json pins the exact v1 shape."""
     return {
+        "v": SNAPSHOT_VERSION,
         "uid": self.uid,
         "prompt": [int(t) for t in np.asarray(self.prompt).reshape(-1)],
         "max_new_tokens": int(self.max_new_tokens),
@@ -160,8 +175,19 @@ class Request:
 
   @classmethod
   def restore(cls, snap: Dict[str, Any]) -> "Request":
-    """Inverse of :meth:`snapshot` (tolerates a JSON round trip)."""
+    """Inverse of :meth:`snapshot` (tolerates a JSON round trip).
+    Pre-versioning snapshots (no ``"v"`` key) read as v1 — the field
+    set is identical; an UNKNOWN version is rejected loudly, because
+    silently dropping or misreading a field would break cross-process
+    failover bit-exactness in the quietest possible way."""
     snap = dict(snap)
+    version = snap.pop("v", SNAPSHOT_VERSION)
+    if version != SNAPSHOT_VERSION:
+      raise ValueError(
+          f"unsupported request snapshot version {version!r}: this build "
+          f"reads v{SNAPSHOT_VERSION} (a newer writer must not feed an "
+          f"older reader across the failover wire — upgrade the reader "
+          f"or re-snapshot with a v{SNAPSHOT_VERSION} writer)")
     snap["prompt"] = np.asarray(snap["prompt"], np.int32)
     return cls(**snap)
 
@@ -669,6 +695,24 @@ class FCFSScheduler:
           c.first_token_emitted if c is not None else False,
           entry.submitted_at))
     return snaps
+
+  def progress(self) -> List[Any]:
+    """``[(uid, generated_token_list)]`` for every live request, in
+    service order (active slots by admission order, then the queue) —
+    the committed-token watermark stream a transport worker reports so
+    the router-side crash journal can replay bit-exactly
+    (serving/transport.py).  Lives beside :meth:`snapshot_requests`
+    because it walks the identical structure — the wire layer must
+    never reach into scheduler internals for it."""
+    out: List[Any] = []
+    for slot in self._admit_order:
+      state = self.active[slot]
+      out.append((state.req.uid, state.generated))
+    for entry in self.pending:
+      carried = entry.carried
+      out.append((entry.req.uid,
+                  carried.generated if carried is not None else []))
+    return out
 
   def restore_request(self, snap: Dict[str, Any],
                       front: bool = False) -> Any:

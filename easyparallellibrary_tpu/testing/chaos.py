@@ -32,17 +32,38 @@ chaos-serve`) — the adversaries of serving/resilience.py:
   admission-control / shedding episodes).
 
 Router-fleet faults (`tests/test_serving_router.py`, `make
-chaos-router`) — the adversaries of serving/router.py's control plane:
+chaos-router`) — the adversaries of serving/router.py's control plane.
+:class:`ReplicaKiller`, :class:`ReplicaHang` and
+:class:`FlappingHealth` are **in-process simulations** (they poison the
+fused step of a thread-hosted replica — fast, deterministic, GIL-bound);
+their real-process counterparts below deliver actual signals:
 
-* replica death — :class:`ReplicaKiller` (a fused-step dispatch raises
-  mid-decode; the router must fail the replica's queued + in-flight
-  requests over to survivors bit-exactly via prefix replay);
-* replica hangs — :class:`ReplicaHang` (stalled dispatches age the
-  heartbeat; the health machine must mark the replica suspect, route
-  around it, and recover on a clean beat);
-* flapping health — :class:`FlappingHealth` (periodic death/recovery;
-  the circuit breaker must double its hold-out per trip instead of
-  bouncing requests through endless failovers).
+* replica death — :class:`ReplicaKiller` (in-process simulation: a
+  fused-step dispatch raises mid-decode; the router must fail the
+  replica's queued + in-flight requests over to survivors bit-exactly
+  via prefix replay);
+* replica hangs — :class:`ReplicaHang` (in-process simulation: stalled
+  dispatches age the heartbeat; the health machine must mark the
+  replica suspect, route around it, and recover on a clean beat);
+* flapping health — :class:`FlappingHealth` (in-process simulation:
+  periodic death/recovery; the circuit breaker must double its
+  hold-out per trip instead of bouncing requests through endless
+  failovers).
+
+Process-transport faults (`tests/test_serving_transport.py`, `make
+chaos-proc`) — the REAL fault domain, against
+serving/transport.py's process-isolated replicas:
+
+* process death — :class:`ProcessKiller` (``os.kill(pid, SIGKILL)`` on
+  a replica's child: one replica's memory genuinely vanishes; recovery
+  must come from the router-side journal, bit-exactly);
+* process stalls — :class:`ProcessStaller` (``SIGSTOP``/``SIGCONT``: a
+  genuinely frozen child — no GIL sharing — that must trip the wire
+  deadline, be condemned, fenced and failed over);
+* lost replies — :class:`ReplyDropper` (reads a reply frame off the
+  wire and discards it: the ambiguous-timeout case — the child applied
+  the call but the parent never heard — that uid dedup and journal
+  watermark resync must make exactly-once).
 
 These mutate real files, deliver real signals and poison real device
 calls; none of them are imported by library code.
@@ -378,15 +399,19 @@ class FlakyDrafter:
 
 
 class ReplicaKiller(_StepFnWrapper):
-  """Kill a serving replica mid-decode: chosen fused-step dispatches
-  raise instead of returning — from the router's point of view the
-  replica died with requests in flight (the single-process stand-in for
-  SIGKILL: the device call never comes back, the exception unwinds the
-  replica's step, and only HOST state — the scheduler's committed
-  prefixes — survives for the control plane to recover).  The router
-  must mark the replica down, snapshot its queued + in-flight requests,
-  and resume every one on a survivor bit-exactly via prefix replay
-  (serving/router.py; `make chaos-router`).
+  """Kill a serving replica mid-decode — **in-process simulation**:
+  chosen fused-step dispatches raise instead of returning, so from the
+  router's point of view the replica died with requests in flight.  It
+  is a single-process STAND-IN for SIGKILL, not the real thing: the
+  replica shares this process's memory and GIL, the "kill" is an
+  exception unwinding its step, and its host state survives intact for
+  evacuation.  For the real fault domain — a subprocess whose memory
+  genuinely vanishes under ``os.kill(pid, SIGKILL)`` — use
+  :class:`ProcessKiller` against a ProcessTransport replica.  Either
+  way the router must mark the replica down, recover its queued +
+  in-flight requests, and resume every one on a survivor bit-exactly
+  via prefix replay (serving/router.py; `make chaos-router` /
+  `make chaos-proc`).
 
   ``kill_calls`` are 0-based device-call indices; each listed call
   raises ONCE (so a later probe/rejoin of the same replica finds a
@@ -410,8 +435,12 @@ class ReplicaKiller(_StepFnWrapper):
 
 
 class ReplicaHang(HangingStepInjector):
-  """Stall a replica's fused-step dispatches (same mechanism as
-  :class:`HangingStepInjector`, named for the router suite).  The
+  """Stall a replica's fused-step dispatches — **in-process
+  simulation** (same mechanism as :class:`HangingStepInjector`, named
+  for the router suite): the "hang" is a host ``sleep`` sharing this
+  process's GIL, not a frozen process — for the real thing
+  (``SIGSTOP`` on a child that then genuinely cannot answer the wire)
+  use :class:`ProcessStaller`.  The
   detector is the per-replica StepWatchdog — its monitor THREAD fires
   during the stall (the synchronous router can't observe a hang it is
   blocked inside), the timeout count rides the replica's next
@@ -448,6 +477,105 @@ class FlappingHealth(_StepFnWrapper):
       raise RuntimeError(f"chaos: flapping replica failed again "
                          f"(device call {call})")
     return self.inner(params, *args)
+
+
+# ------------------------------------------------ process-transport faults --
+
+
+class ProcessKiller:
+  """SIGKILL a process-hosted replica's child — the REAL replica death
+  :class:`ReplicaKiller` simulates: the child's memory (engine, KV
+  cache, scheduler state, everything) is gone the instant the signal
+  lands, so there is no corpse to RPC.  The router must detect the
+  death at the wire (pipe EOF / waitpid), fence, and recover the
+  replica's queued + in-flight requests from its parent-side journal —
+  bit-exactly, via prefix replay from the last committed watermark
+  (serving/transport.py; `make chaos-proc`)."""
+
+  def __init__(self, transport):
+    self.transport = transport
+    self.kills = 0
+    self.killed_pids: list = []
+
+  def kill(self) -> int:
+    """Deliver SIGKILL now; returns the victim pid."""
+    pid = self.transport.child_pid
+    if pid is None:
+      raise RuntimeError("ProcessKiller: transport has no live child")
+    self.transport.kill(_signal.SIGKILL)
+    self.kills += 1
+    self.killed_pids.append(pid)
+    return pid
+
+
+class ProcessStaller:
+  """Freeze a process-hosted replica's child with SIGSTOP — a genuinely
+  hung worker (no GIL sharing, unlike :class:`ReplicaHang`'s host
+  sleep): the child cannot answer the wire at all, so the parent's
+  per-call deadline must trip, condemn the replica (a step is not
+  idempotent — it can never be retried against a maybe-still-applying
+  child) and fence it with SIGKILL before failing its requests over
+  from the journal.  :meth:`resume` (SIGCONT) models the stall ending —
+  AFTER a fence it arrives at a corpse, which is the point: a fenced
+  replica can never double-serve."""
+
+  def __init__(self, transport):
+    self.transport = transport
+    self.stalls = 0
+
+  def stall(self) -> int:
+    pid = self.transport.child_pid
+    if pid is None:
+      raise RuntimeError("ProcessStaller: transport has no live child")
+    self.transport.kill(_signal.SIGSTOP)
+    self.stalls += 1
+    return pid
+
+  def resume(self) -> None:
+    pid = self.transport.child_pid
+    if pid is not None:
+      try:
+        os.kill(pid, _signal.SIGCONT)
+      except ProcessLookupError:
+        pass  # already fenced — the expected post-failover outcome
+
+
+class ReplyDropper:
+  """Drop chosen reply frames at the parent's wire — the ambiguous
+  timeout made deterministic: the child APPLIED the call and answered,
+  but the parent never hears it (the frame is read off the socket and
+  discarded, then the read raises the same :class:`TransportTimeout`
+  a deadline miss would).  The exactly-once machinery under test:
+  a retried ``submit`` must hit the child's uid dedup and admit once;
+  a lost ``step`` reply must not double-commit tokens — the journal's
+  acked-watermark resync (next reply resends the suffix) or the
+  failover replay (deterministic regeneration) must both land the
+  identical stream.
+
+  ``drop`` are 0-based indices counting every reply frame this parent
+  reads from the child."""
+
+  def __init__(self, transport, drop: Sequence[int]):
+    self.transport = transport
+    self.inner = transport._read_frame
+    self.drop = set(drop)
+    self.calls = 0
+    self.dropped: list = []
+    transport._read_frame = self
+
+  def __call__(self, timeout):
+    from easyparallellibrary_tpu.serving.transport import TransportTimeout
+    frame = self.inner(timeout)
+    call, self.calls = self.calls, self.calls + 1
+    if call in self.drop:
+      self.drop.discard(call)
+      self.dropped.append(frame)
+      raise TransportTimeout(
+          f"chaos: reply frame {call} dropped after the child applied it")
+    return frame
+
+  def uninstall(self):
+    self.transport._read_frame = self.inner
 
 
 def poisson_trace(rate_per_s: float, n: int, seed: int = 0,

@@ -34,6 +34,7 @@ def retry_call(fn: Callable[..., Any],
                retries: Optional[int] = None,
                backoff_s: Optional[float] = None,
                max_backoff_s: float = 2.0,
+               jitter: float = 0.0,
                exceptions: Tuple[Type[BaseException], ...] = (),
                on_retry: Optional[Callable[[int, BaseException], None]] = None,
                what: str = "",
@@ -43,7 +44,11 @@ def retry_call(fn: Callable[..., Any],
   ``retries`` is the number of RE-tries after the first attempt
   (``retries=0`` means one attempt, no retry); defaults to the active
   config's ``resilience.io_retries``.  Backoff doubles each attempt,
-  capped at ``max_backoff_s``.  ``on_retry(attempt, exc)`` is invoked
+  capped at ``max_backoff_s``.  ``jitter`` stretches each sleep by a
+  uniformly random factor in ``[1, 1 + jitter]`` — RPC retries against
+  a shared replica (serving/transport.py) must decorrelate, or every
+  caller that timed out together retries together and the thundering
+  herd re-times-out together.  ``on_retry(attempt, exc)`` is invoked
   before each sleep — callers use it to count retries into metrics.
   The final failure re-raises the last exception unchanged.
   """
@@ -54,6 +59,8 @@ def retry_call(fn: Callable[..., Any],
       retries = res.io_retries
     if backoff_s is None:
       backoff_s = res.io_retry_backoff_s
+  if jitter < 0:
+    raise ValueError(f"jitter must be >= 0: {jitter}")
   default_set = not exceptions
   exceptions = exceptions or TRANSIENT_EXCEPTIONS
   delay = max(0.0, backoff_s)
@@ -65,13 +72,18 @@ def retry_call(fn: Callable[..., Any],
         raise
       if attempt >= retries:
         raise
+      sleep_s = delay
+      if delay and jitter:
+        import random
+        sleep_s = delay * (1.0 + random.uniform(0.0, jitter))
       get_logger().warning(
           "transient failure%s (attempt %d/%d): %s — retrying in %.2fs",
-          f" in {what}" if what else "", attempt + 1, retries + 1, e, delay)
+          f" in {what}" if what else "", attempt + 1, retries + 1, e,
+          sleep_s)
       if on_retry is not None:
         on_retry(attempt + 1, e)
-      if delay:
-        time.sleep(delay)
+      if sleep_s:
+        time.sleep(sleep_s)
       delay = min(delay * 2 if delay else 0.0, max_backoff_s)
   raise AssertionError("unreachable")  # pragma: no cover
 

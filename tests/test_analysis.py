@@ -122,6 +122,36 @@ def test_host_sync_fires_on_subdir_and_single_file_scans(tmp_path):
     assert [f.line for f in findings] == [8], root
 
 
+def test_host_sync_covers_transport_module(tmp_path):
+  """The replica-transport layer (ISSUE 12) is hot-path for the
+  host-sync rule: the SHIPPED serving/transport.py and
+  serving/replica.py scan as hot (any implicit device->host fetch a
+  future edit introduces on the RPC path is a finding, and the shipped
+  baseline stays empty — the quick zero-findings acceptance below
+  enforces that), pinned here against a fixture twin so a marker
+  refactor cannot silently drop the module."""
+  from easyparallellibrary_tpu.analysis.rules import _is_hot
+  from easyparallellibrary_tpu.analysis.core import ModuleInfo
+  pkg = package_root()
+  for rel in ("serving/transport.py", "serving/replica.py"):
+    shipped = os.path.join(pkg, rel)
+    assert os.path.exists(shipped)
+    assert _is_hot(ModuleInfo(path=shipped, rel=rel, source="",
+                              tree=None, parse_error=None)), rel
+  path = _write(tmp_path, "serving/transport.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def encode_step_reply(x):
+        return np.asarray(_fn(x)).tolist()
+      """)
+  findings = _by_rule(_run(path), "host-sync")
+  assert [f.line for f in findings] == [8]
+
+
 def test_host_sync_flags_implicit_bool_and_float(tmp_path):
   _write(tmp_path, "runtime/loop.py", """\
       def fit(step_fn, state, batch):

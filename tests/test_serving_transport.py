@@ -1,0 +1,664 @@
+"""Replica transports (ISSUE 12): process-isolated replicas behind a
+wire with real timeouts, retries, and SIGKILL-survivable failover.
+
+The acceptance contract (`make chaos-proc`): with two ProcessTransport
+replicas — each a spawned subprocess owning its own JAX runtime —
+``os.kill(pid, SIGKILL)`` of one mid-decode loses ZERO requests, the
+recovered streams are bit-exact vs the fault-free single-engine oracle,
+and the survivor's fused-step compile count stays 1 (failover is a
+prefix replay — no new shapes).  The InprocTransport default is
+byte-for-byte PR-8 behavior (the fault-free N=1 router stream stays
+bit-identical to the bare engine with zero added recompiles).  The
+ambiguous-timeout cases are pinned: a submit whose reply is dropped
+after the child applied it admits exactly once (uid dedup), and a step
+reply lost mid-flight never double-commits tokens on recovery replay
+(journal watermark resync + deterministic regeneration).
+
+Subprocess spawns cost seconds each (child JAX import + engine
+compile); the heavier episodes (SIGSTOP stalls that must burn wire
+deadlines, breaker-probe respawns that must burn cooldowns) are
+``slow``-marked for the tier-1 window — `make chaos-proc` runs them
+all.
+"""
+
+import json
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.profiler.serving import (
+    ServingStats, fleet_summary)
+from easyparallellibrary_tpu.serving import (
+    ContinuousBatchingEngine, InprocTransport, ProcessTransport,
+    ReplicaDeadError, Request, Router, TransportTimeout)
+from easyparallellibrary_tpu.serving import transport as transport_lib
+from easyparallellibrary_tpu.serving.replica import EngineReplica
+from easyparallellibrary_tpu.serving.scheduler import SNAPSHOT_VERSION
+from easyparallellibrary_tpu.testing import chaos
+from easyparallellibrary_tpu.testing.factories import tiny_gpt
+from easyparallellibrary_tpu.utils.retry import retry_call
+
+FACTORY = {"fn": "easyparallellibrary_tpu.testing.factories:tiny_gpt"}
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "request_snapshot_v1.json")
+
+
+def _prompts(n, plen=6, vocab=64, seed=0):
+  r = np.random.RandomState(seed)
+  return [r.randint(0, vocab, (plen,)).astype(np.int32)
+          for _ in range(n)]
+
+
+def _oracle_outputs(prompts, max_new=10, **engine_kwargs):
+  """Fault-free single-engine streams from the SAME factory the child
+  processes build from — the bit-exactness baseline."""
+  model, params = tiny_gpt()
+  eng = ContinuousBatchingEngine(model, params, num_slots=4,
+                                 prefill_chunk=4, **engine_kwargs)
+  for i, p in enumerate(prompts):
+    eng.submit(Request(uid=i, prompt=p, max_new_tokens=max_new))
+  out = eng.run()
+  eng.close()
+  return out
+
+
+def _process_config(**router):
+  conf = {"transport": "process", "rpc_timeout_s": 60.0,
+          "rpc_retries": 2, "rpc_backoff_s": 0.05}
+  conf.update(router)
+  return epl.Config({"serving": {"router": conf}})
+
+
+def _assert_no_orphans(pids):
+  time.sleep(0.1)
+  for pid in pids:
+    if pid is None:
+      continue
+    try:
+      os.kill(pid, 0)
+    except ProcessLookupError:
+      continue
+    pytest.fail(f"orphan replica child still alive: pid {pid}")
+
+
+# --------------------------------------------------- snapshot versioning
+
+
+def test_request_snapshot_matches_v1_golden():
+  """The v1 wire shape is PINNED: a future field change must bump
+  SNAPSHOT_VERSION and grow a new golden, not silently reshape what
+  crosses the failover wire."""
+  with open(GOLDEN) as f:
+    golden = json.load(f)
+  restored = Request.restore(golden)
+  assert restored.uid == "golden-1"
+  assert restored.priority == "latency"
+  assert np.array_equal(restored.prompt, np.asarray([5, 6, 7, 8]))
+  resnap = json.loads(json.dumps(restored.snapshot()))
+  assert resnap == golden
+  assert golden["v"] == SNAPSHOT_VERSION == 1
+
+
+def test_request_snapshot_carries_version_and_rejects_unknown():
+  snap = Request(uid="u", prompt=np.asarray([1, 2], np.int32),
+                 max_new_tokens=3).snapshot()
+  assert snap["v"] == SNAPSHOT_VERSION
+  # Pre-versioning snapshots (no "v") read as v1 — same field set.
+  legacy = {k: v for k, v in snap.items() if k != "v"}
+  assert Request.restore(legacy).uid == "u"
+  bad = dict(snap, v=99)
+  with pytest.raises(ValueError, match="snapshot version 99"):
+    Request.restore(bad)
+
+
+# --------------------------------------------------------- wire plumbing
+
+
+def test_frame_reader_survives_partial_frames_and_timeouts():
+  import socket
+  a, b = socket.socketpair()
+  try:
+    reader = transport_lib.FrameReader(a)
+    payload = json.dumps({"id": 1, "m": "x"}).encode()
+    frame = transport_lib._LEN.pack(len(payload)) + payload
+    # First half only: the read must time out WITHOUT losing the bytes.
+    b.sendall(frame[:3])
+    with pytest.raises(TransportTimeout):
+      reader.read(0.05)
+    b.sendall(frame[3:])
+    assert reader.read(0.5) == {"id": 1, "m": "x"}
+    # Two frames in one burst: framing separates them.
+    b.sendall(frame + frame)
+    assert reader.read(0.5)["id"] == 1
+    assert reader.read(0.5)["id"] == 1
+    b.close()
+    with pytest.raises(ReplicaDeadError):
+      reader.read(0.5)
+  finally:
+    a.close()
+
+
+def test_retry_jitter_bounds(monkeypatch):
+  sleeps = []
+  monkeypatch.setattr("time.sleep", sleeps.append)
+  calls = {"n": 0}
+
+  def flaky():
+    calls["n"] += 1
+    if calls["n"] <= 2:
+      raise TransportTimeout("transient")
+    return "ok"
+
+  assert retry_call(flaky, retries=2, backoff_s=0.1, jitter=0.5,
+                    exceptions=(TransportTimeout,)) == "ok"
+  assert len(sleeps) == 2
+  assert 0.1 <= sleeps[0] <= 0.1 * 1.5 + 1e-9
+  assert 0.2 <= sleeps[1] <= 0.2 * 1.5 + 1e-9
+  with pytest.raises(ValueError, match="jitter"):
+    retry_call(lambda: None, retries=0, backoff_s=0.0, jitter=-1.0)
+
+
+def test_serving_stats_state_roundtrip_feeds_fleet_summary():
+  clock = [0.0]
+  stats = ServingStats(clock=lambda: clock[0])
+  for uid in range(3):
+    stats.note_submitted(uid)
+    clock[0] += 0.01
+    stats.note_first_token(uid)
+    clock[0] += 0.05
+    stats.note_finished(uid, new_tokens=5, finish_reason="stop")
+  stats.note_step(active_slots=2, num_slots=4, prefill_tokens=8,
+                  decode_tokens=2, step_time_s=0.02)
+  stats.note_shed("x")
+  state = json.loads(json.dumps(stats.state_dict()))
+  twin = ServingStats()
+  twin.load_state(state)
+  assert twin.summary() == stats.summary()
+  assert (fleet_summary([twin])["ttft_p99_s"]
+          == fleet_summary([stats])["ttft_p99_s"])
+
+
+def test_config_transport_validation():
+  with pytest.raises(ValueError, match="transport"):
+    epl.Config({"serving": {"router": {"transport": "carrier-pigeon"}}})
+  with pytest.raises(ValueError, match="rpc_timeout_s"):
+    epl.Config({"serving": {"router": {"rpc_timeout_s": 0.0}}})
+  with pytest.raises(ValueError, match="rpc_retries"):
+    epl.Config({"serving": {"router": {"rpc_retries": -1}}})
+  conf = epl.Config()
+  assert conf.serving.router.transport == "inproc"
+  assert conf.serving.router.rpc_timeout_s > 0
+
+
+def test_process_transport_requires_factory():
+  with pytest.raises(ValueError, match="factory"):
+    Router(num_replicas=1, config=_process_config())
+
+
+# ----------------------------------------------- inproc transport (seam)
+
+
+@pytest.mark.quick
+def test_inproc_transport_default_fault_free_bit_exact_zero_recompile():
+  """The transport seam changes NOTHING unless opted into: the default
+  (explicitly named inproc) N=1 router stream is bit-identical to the
+  bare engine, with the one compiled step intact (no transport-induced
+  recompiles)."""
+  prompts = _prompts(4)
+  oracle = _oracle_outputs(prompts)
+  model, params = tiny_gpt()
+  router = Router(model, params, num_replicas=1,
+                  config=epl.Config({"serving": {"router": {
+                      "transport": "inproc"}}}),
+                  num_slots=4, prefill_chunk=4)
+  assert router.transport == "inproc"
+  rep = router.replicas[0]
+  assert isinstance(rep, InprocTransport)
+  assert isinstance(rep, EngineReplica)   # byte-for-byte PR-8 replica
+  assert rep.alive and rep.ensure_started() is False
+  assert rep.rpc_counters() == {"rpc_retries": 0, "rpc_timeouts": 0,
+                                "child_restarts": 0}
+  for i, p in enumerate(prompts):
+    router.submit(Request(uid=i, prompt=p, max_new_tokens=10))
+  out = router.run()
+  assert set(out) == set(oracle)
+  for uid in oracle:
+    assert np.array_equal(out[uid], oracle[uid]), uid
+  assert rep.compile_count == 1
+  counters = router.router_counters()
+  assert counters["rpc_retries"] == counters["rpc_timeouts"] == 0.0
+  router.close()
+
+
+# ------------------------------------------ process transport: happy path
+
+
+def test_process_transport_serves_and_reaps_cleanly():
+  prompts = _prompts(3)
+  oracle = _oracle_outputs(prompts)
+  router = Router(num_replicas=1, config=_process_config(),
+                  factory=FACTORY, num_slots=4, prefill_chunk=4)
+  rep = router.replicas[0]
+  pid = rep.child_pid
+  assert rep.alive and pid is not None
+  for i, p in enumerate(prompts):
+    assert router.submit(Request(uid=i, prompt=p, max_new_tokens=10))
+  out = router.run()
+  for uid in oracle:
+    assert np.array_equal(out[uid], oracle[uid]), uid
+  # Wire heartbeat carried the child's signals (compile-once included).
+  beat = rep.wire_beat
+  assert beat is not None and beat["compiles"] == 1
+  assert beat["pid"] == pid
+  assert rep.compile_count == 1
+  # A malformed request is a CLIENT error, never replica death: it
+  # crosses the wire, the child's validation rejects it, and the
+  # ValueError surfaces to the caller with the replica still healthy
+  # and the journal clean (no resurrection later).
+  with pytest.raises(ValueError):
+    router.submit(Request(uid="bad", prompt=np.zeros((0,), np.int32),
+                          max_new_tokens=4))
+  assert rep.alive and router.states() == ["healthy"]
+  assert not rep.owns("bad")
+  assert router.submit(Request(uid="bad", prompt=prompts[0],
+                               max_new_tokens=4))
+  router.run()
+  assert router.finished["bad"].new_tokens == 4
+  router.close()
+  assert not rep.alive
+  _assert_no_orphans([pid])
+
+
+# --------------------------------------------- the acceptance: SIGKILL
+
+
+@pytest.mark.quick
+def test_process_sigkill_mid_decode_bit_exact_failover():
+  """ISSUE 12 acceptance: SIGKILL one of two process replicas
+  mid-decode — zero requests lost, every recovered stream bit-exact vs
+  the fault-free oracle (recovered from the ROUTER-SIDE journal; the
+  corpse cannot be asked anything), survivor compile count stays 1."""
+  prompts = _prompts(6)
+  oracle = _oracle_outputs(prompts)
+  router = Router(num_replicas=2, config=_process_config(),
+                  factory=FACTORY, num_slots=4, prefill_chunk=4)
+  pids = [rep.child_pid for rep in router.replicas]
+  for i, p in enumerate(prompts):
+    assert router.submit(Request(uid=i, prompt=p, max_new_tokens=10))
+  for _ in range(3):            # let decode get going on both children
+    router.step()
+  victim = router.replicas[0]
+  survivor = router.replicas[1]
+  assert victim.has_work, "victim must die MID-decode, not idle"
+  killer = chaos.ProcessKiller(victim)
+  killer.kill()
+  router.run()
+  assert router.failovers >= 1
+  assert victim.exit_signal == signal.SIGKILL
+  served = {i: np.asarray(router.finished[i].tokens)
+            for i in range(len(prompts)) if i in router.finished}
+  assert set(served) == set(oracle), "zero lost requests"
+  for uid in oracle:
+    assert np.array_equal(served[uid], oracle[uid]), uid
+  # Compile sentinel silent: the survivor's fused step compiled ONCE —
+  # journal replay is chunked prefill, never a new shape.
+  assert survivor.compile_count == 1
+  router.close()
+  _assert_no_orphans(pids)
+
+
+# ------------------------------------- ambiguous timeouts: exactly-once
+
+
+def test_submit_reply_dropped_then_retried_admits_exactly_once():
+  """The reply to a submit is lost AFTER the child admitted it; the
+  transport's jittered-backoff retry resends; the child's uid dedup
+  returns the recorded verdict instead of double-admitting — the
+  request is served exactly once, bit-exactly."""
+  prompts = _prompts(2)
+  oracle = _oracle_outputs(prompts)
+  router = Router(num_replicas=1, config=_process_config(),
+                  factory=FACTORY, num_slots=4, prefill_chunk=4)
+  rep = router.replicas[0]
+  pid = rep.child_pid
+  # Drop the NEXT reply this parent reads (= the first submit's).
+  dropper = chaos.ReplyDropper(rep, drop=(0,))
+  assert router.submit(Request(uid=0, prompt=prompts[0],
+                               max_new_tokens=10))
+  assert dropper.dropped, "the submit reply must actually have dropped"
+  assert rep.rpc_counters()["rpc_retries"] >= 1
+  dropper.uninstall()
+  assert router.submit(Request(uid=1, prompt=prompts[1],
+                               max_new_tokens=10))
+  out = router.run()
+  assert set(out) == {0, 1}
+  for uid in oracle:
+    assert np.array_equal(out[uid], oracle[uid]), uid
+  # Exactly once: the child admitted uid 0 a single time, so its token
+  # count is the oracle's — a double admit would have shed or doubled.
+  assert router.finished[0].new_tokens == oracle[0].size - prompts[0].size
+  router.close()
+  _assert_no_orphans([pid])
+
+
+def test_step_reply_lost_midflight_no_double_commit_on_replay():
+  """A step reply vanishes mid-flight: the parent's journal watermark
+  goes stale while the child committed tokens.  The replica is
+  condemned (steps are never retried), fenced, and its requests replay
+  on the survivor from the stale watermark — deterministic regeneration
+  means the recovered stream is bit-exact with NO double-committed
+  tokens."""
+  prompts = _prompts(4)
+  oracle = _oracle_outputs(prompts)
+  router = Router(num_replicas=2, config=_process_config(),
+                  factory=FACTORY, num_slots=4, prefill_chunk=4)
+  pids = [rep.child_pid for rep in router.replicas]
+  for i, p in enumerate(prompts):
+    assert router.submit(Request(uid=i, prompt=p, max_new_tokens=10))
+  for _ in range(2):
+    router.step()
+  victim = router.replicas[0]
+  assert victim.has_work
+  journal_before = {uid: len(e.generated)
+                    for uid, e in victim._journal.items()}
+  # Drop the victim's next step reply: its committed tokens never reach
+  # the parent journal.
+  chaos.ReplyDropper(victim, drop=(0,))
+  router.run()
+  assert router.failovers >= 1, "dropped step reply must condemn"
+  assert victim.exit_signal == signal.SIGKILL    # fenced, not trusted
+  served = {i: np.asarray(router.finished[i].tokens)
+            for i in range(len(prompts)) if i in router.finished}
+  assert set(served) == set(oracle)
+  for uid in oracle:
+    assert np.array_equal(served[uid], oracle[uid]), \
+        (uid, journal_before)
+  assert router.router_counters()["rpc_timeouts"] >= 1
+  router.close()
+  _assert_no_orphans(pids)
+
+
+# ------------------------------------------------ transport observability
+
+
+class _DuckReplica:
+  """Minimal duck-typed transport for router-policy tests (no device)."""
+
+  def __init__(self, index, rpc=None, die=False):
+    self.index = index
+    self.stats = None
+    self.finished = {}
+    self.has_work = die           # a dying replica owes work
+    self.watchdog_timeouts = 0
+    self.bad_steps = 0
+    self.itl_ewma_s = 0.0
+    self.num_slots = 4
+    self.queue_depth = 0
+    self.num_active = 0
+    self.load = 0
+    self.exit_signal = signal.SIGKILL if die else None
+    self.child_pid = 4242 if die else None
+    self._rpc = rpc or {"rpc_retries": 0, "rpc_timeouts": 0,
+                        "child_restarts": 0}
+    self._die = die
+
+  def submit(self, req):
+    return True
+
+  def cancel(self, uid):
+    return False
+
+  def step(self):
+    if self._die:
+      raise ReplicaDeadError("chaos: child gone")
+    return []
+
+  def snapshot_requests(self):
+    return list(getattr(self, "snaps", []))
+
+  def evacuate(self):
+    self.has_work = False
+    snaps, self.snaps = list(getattr(self, "snaps", [])), []
+    return snaps
+
+  def restore_request(self, snap, front=False):
+    if getattr(self, "restore_raises", False):
+      raise ReplicaDeadError("chaos: died during restore")
+    self.restored = getattr(self, "restored", [])
+    self.restored.append(snap["request"]["uid"])
+    return snap["request"]["uid"]
+
+  def rpc_counters(self):
+    return dict(self._rpc)
+
+  def close(self):
+    pass
+
+
+def test_cancel_survives_replica_death_and_reaches_parked():
+  """Review regression: a cancel whose replica dies mid-call must not
+  surface a transport error (or be silently lost to a later failover
+  replay) — the router fails the replica over and cancels the request
+  wherever it landed."""
+  def _snap(uid):
+    return {"request": Request(uid=uid, prompt=np.asarray([3, 4], np.int32),
+                               max_new_tokens=4).snapshot(),
+            "generated": [7], "requeues": 0,
+            "first_token_emitted": True, "submitted_at": 0.0}
+  epl.init()
+  rep = _DuckReplica(0, die=True)
+  rep.snaps = [_snap("x")]
+
+  def dying_cancel(uid):
+    raise TransportTimeout("chaos: cancel reply lost")
+  rep.cancel = dying_cancel
+  router = Router(replicas=[rep])
+  router.placement["x"] = 0
+  assert router.cancel("x") is True          # no exception to the client
+  assert router.finished["x"].finish_reason == "cancelled"
+  assert np.array_equal(router.finished["x"].tokens,
+                        np.asarray([3, 4, 7], np.int32))
+  assert router.states() == ["down"]
+  assert not router._parked                   # resolved, not resurrected
+  router.close()
+
+
+def test_failover_placement_survives_dying_target():
+  """Review regression: a survivor that dies DURING snapshot placement
+  must not take the remaining snapshots with it — the dead target is
+  marked down and the rest land on the next survivor (or park); an
+  outage delays, it never loses."""
+  def _snap(uid):
+    return {"request": Request(uid=uid, prompt=np.asarray([1, 2], np.int32),
+                               max_new_tokens=4).snapshot(),
+            "generated": [], "requeues": 0,
+            "first_token_emitted": False, "submitted_at": 0.0}
+  epl.init()
+  dying = _DuckReplica(0, die=True)
+  dying.snaps = [_snap("a"), _snap("b"), _snap("c")]
+  bad_target = _DuckReplica(1)
+  bad_target.restore_raises = True
+  good_target = _DuckReplica(2)
+  router = Router(replicas=[dying, bad_target, good_target])
+  router.step()
+  assert router.failovers == 1
+  # All three snapshots reached the one target that survived placement.
+  assert sorted(good_target.restored) == ["a", "b", "c"]
+  assert router.states()[1] == "down"       # mid-placement death noticed
+  assert len(router._parked) == 0
+  assert {router.placement[u] for u in ("a", "b", "c")} == {2}
+  router.close()
+
+
+def test_replica_down_instant_carries_signal_and_rollup_rpc_counters(
+    tmp_path):
+  """Satellite 6: transport incidents ride the EXISTING schema — the
+  fleet rollup carries summed rpc_retries/rpc_timeouts/child_restarts
+  (so the SLO monitor and diagnostic bundles see them with zero new
+  plumbing), and a dead replica emits a ``serving/replica_down`` trace
+  instant naming the kill signal."""
+  from easyparallellibrary_tpu.observability import trace as trace_lib
+  from easyparallellibrary_tpu.observability import slo as slo_lib
+  epl.init(epl.Config({"observability": {"enabled": True}}))
+  try:
+    tracer = trace_lib.ensure_configured()
+    dead = _DuckReplica(0, rpc={"rpc_retries": 3, "rpc_timeouts": 1,
+                                "child_restarts": 2}, die=True)
+    ok = _DuckReplica(1)
+    router = Router(replicas=[dead, ok])
+    router.step()
+    counters = router.router_counters()
+    assert counters["rpc_retries"] == 3.0
+    assert counters["rpc_timeouts"] == 1.0
+    assert counters["child_restarts"] == 2.0
+    rollup = router.fleet_summary()
+    for key in ("rpc_retries", "rpc_timeouts", "child_restarts"):
+      assert rollup[key] == counters[key]
+    trace_path = str(tmp_path / "trace.json")
+    assert tracer.export(trace_path)
+    with open(trace_path) as f:
+      events = json.load(f)["traceEvents"]
+    downs = [e for e in events
+             if e.get("name") == "serving/replica_down"]
+    assert len(downs) == 1
+    assert downs[0]["args"]["signal"] == "SIGKILL"
+    assert downs[0]["args"]["replica"] == 0
+    assert downs[0]["args"]["pid"] == 4242
+  finally:
+    trace_lib.reset()
+    slo_lib.reset()
+
+
+# ----------------------------------------------- stalls, probes, orphans
+
+
+@pytest.mark.slow
+def test_process_stall_sigstop_condemns_fences_and_fails_over():
+  """A SIGSTOPped child is a genuinely frozen process: the wire
+  deadline trips, the replica is condemned (never retried — the stall
+  might end mid-apply), fenced with SIGKILL so it can never
+  double-serve, and its requests finish bit-exactly on the survivor."""
+  prompts = _prompts(4)
+  oracle = _oracle_outputs(prompts)
+  router = Router(num_replicas=2, config=_process_config(rpc_retries=0),
+                  factory=FACTORY, num_slots=4, prefill_chunk=4)
+  pids = [rep.child_pid for rep in router.replicas]
+  # Warm both children under the generous default deadline (the first
+  # step carries XLA compilation), THEN tighten the wire deadline so
+  # the stall — not the compile — is what trips it.
+  for k, rep in enumerate(router.replicas):
+    rep.submit(Request(uid=f"warm{k}", prompt=prompts[0],
+                       max_new_tokens=2))
+  router.run()
+  for rep in router.replicas:
+    rep.rpc_timeout_s = 2.0
+  for i, p in enumerate(prompts):
+    assert router.submit(Request(uid=i, prompt=p, max_new_tokens=10))
+  for _ in range(2):
+    router.step()
+  victim = router.replicas[0]
+  assert victim.has_work
+  staller = chaos.ProcessStaller(victim)
+  staller.stall()
+  router.run()
+  assert router.failovers >= 1
+  assert router.router_counters()["rpc_timeouts"] >= 1
+  assert victim.exit_signal == signal.SIGKILL    # fenced while stopped
+  staller.resume()            # post-fence SIGCONT: arrives at a corpse
+  served = {i: np.asarray(router.finished[i].tokens)
+            for i in range(len(prompts)) if i in router.finished}
+  assert set(served) == set(oracle)
+  for uid in oracle:
+    assert np.array_equal(served[uid], oracle[uid]), uid
+  router.close()
+  _assert_no_orphans(pids)
+
+
+@pytest.mark.slow
+def test_breaker_probe_respawns_dead_child():
+  """After the breaker cooldown a probe must RESPAWN the dead child
+  (fresh process, fresh pid, cold engine) and serve traffic on it."""
+  router = Router(num_replicas=1,
+                  config=_process_config(down_after=1.0,
+                                         suspect_after=0.5),
+                  factory=FACTORY, num_slots=2, prefill_chunk=4)
+  rep = router.replicas[0]
+  old_pid = rep.child_pid
+  prompt = _prompts(1)[0]
+  oracle = _oracle_outputs([prompt], max_new=8)
+  assert router.submit(Request(uid=0, prompt=prompt, max_new_tokens=8))
+  router.step()
+  chaos.ProcessKiller(rep).kill()
+  router.step()               # death observed -> down; request parked
+  assert router.states() == ["down"]
+  deadline = time.monotonic() + 30.0
+  while router.states() != ["healthy"] and time.monotonic() < deadline:
+    time.sleep(0.1)
+    router.step()             # breaker cooldown elapses -> probe
+  assert router.states() == ["healthy"]
+  assert rep.child_restarts == 1
+  assert rep.child_pid != old_pid
+  assert router.router_counters()["child_restarts"] == 1.0
+  out = router.run()          # the parked request resumes, bit-exactly
+  assert np.array_equal(out[0], oracle[0])
+  pids = [old_pid, rep.child_pid]
+  router.close()
+  _assert_no_orphans(pids)
+
+
+@pytest.mark.slow
+def test_wire_version_mismatch_fails_loudly(monkeypatch):
+  before = set(transport_lib._LIVE_CHILDREN)
+  monkeypatch.setattr(transport_lib, "WIRE_VERSION", 999)
+  with pytest.raises(Exception, match="wire version"):
+    ProcessTransport(0, FACTORY, config=_process_config(),
+                     engine_kwargs={"num_slots": 2, "prefill_chunk": 4})
+  # The half-born child was fenced at the failed init, not leaked.
+  _assert_no_orphans(list(set(transport_lib._LIVE_CHILDREN) - before))
+
+
+def test_atexit_reaper_kills_live_children():
+  """A dying router must never leak children: every spawned child is
+  registered with the atexit reaper, and reaping is idempotent."""
+  tr = ProcessTransport(0, FACTORY, config=_process_config(),
+                        engine_kwargs={"num_slots": 2,
+                                       "prefill_chunk": 4})
+  pid = tr.child_pid
+  assert pid in transport_lib._LIVE_CHILDREN
+  transport_lib._reap_orphans()
+  _assert_no_orphans([pid])
+  assert pid not in transport_lib._LIVE_CHILDREN
+  transport_lib._reap_orphans()   # idempotent on an empty registry
+
+
+@pytest.mark.slow
+def test_process_graceful_drain_migrates_over_rpc():
+  """Drain-timeout migration of a LIVE process replica goes through the
+  graceful evacuate RPC (exact scheduler snapshots, child keeps
+  running) — the journal fence is only for the dead."""
+  prompts = _prompts(4)
+  oracle = _oracle_outputs(prompts)
+  router = Router(num_replicas=2, config=_process_config(),
+                  factory=FACTORY, num_slots=4, prefill_chunk=4)
+  pids = [rep.child_pid for rep in router.replicas]
+  for i, p in enumerate(prompts):
+    assert router.submit(Request(uid=i, prompt=p, max_new_tokens=10))
+  for _ in range(2):
+    router.step()
+  router.drain(0, timeout_s=0.0)   # migrate immediately
+  router.run()
+  assert router.replicas[0].alive, "graceful drain must not fence"
+  assert router.migrated_requests >= 1
+  served = {i: np.asarray(router.finished[i].tokens)
+            for i in range(len(prompts)) if i in router.finished}
+  assert set(served) == set(oracle)
+  for uid in oracle:
+    assert np.array_equal(served[uid], oracle[uid]), uid
+  router.close()
+  _assert_no_orphans(pids)
