@@ -49,6 +49,18 @@ chaos-router:
 chaos-proc:
 	python -m pytest tests/test_serving_transport.py -q
 
+# Self-healing chaos: an injected 3x overload burst on a 2-replica
+# process-transport fleet — the autoscaler spawns a third replica (a
+# REAL subprocess), the autotuner tightens budgets, SLO burn recovers
+# with no operator input, every non-shed request bit-exact vs the
+# fault-free oracle, all replica compile counts stay 1, and after
+# recovery the fleet drains back to 2 replicas; plus the quick-marked
+# fault-free-equivalence pin (actuators enabled + no breaches ==
+# baseline stream, zero actuations) (serving/autotune.py,
+# serving/autoscale.py; docs/robustness.md "Self-healing fleet").
+chaos-heal:
+	python -m pytest tests/test_serving_autoscale.py -q
+
 # Continuous batching vs static-batch generate() under Poisson arrivals
 # (benchmarks/decode_throughput.py -> BENCH_EVIDENCE.json; docs/serving.md).
 serve-bench:
@@ -71,6 +83,15 @@ spec-bench:
 # BENCH_EVIDENCE.json; docs/robustness.md "Serving resilience").
 overload-bench:
 	python benchmarks/serving_overload.py
+
+# Self-healing episode benchmark: the same seeded 3x overload burst
+# served by a frozen 2-replica fleet vs one with the autotuner +
+# autoscaler live (in-process replicas — the policy loop, not spawn
+# cost, is what is measured; make chaos-heal covers the real spawn)
+# (benchmarks/self_heal.py -> BENCH_EVIDENCE.json; docs/robustness.md
+# "Self-healing fleet").
+heal-bench:
+	python benchmarks/self_heal.py
 
 # Replica-kill failover episode: 1 vs 2 replicas under a Poisson trace,
 # then kill one mid-decode — zero lost requests, streams bit-exact vs
@@ -106,6 +127,8 @@ help:
 	@echo "  chaos-serve    - serving resilience chaos (NaN/hang/overload)"
 	@echo "  chaos-router   - fleet chaos: replica kills, hangs, flapping health (both transports)"
 	@echo "  chaos-proc     - process-transport chaos: SIGKILL/SIGSTOP/lost replies/orphans"
+	@echo "  chaos-heal     - self-healing fleet: overload burst -> autotune + autoscale -> recover"
+	@echo "  heal-bench     - actuators-on vs frozen fleet under the overload burst"
 	@echo "  serve-bench    - continuous batching vs static generate()"
 	@echo "  paged-bench    - paged vs contiguous KV cache (long-tail trace)"
 	@echo "  spec-bench     - speculative vs plain decode"
@@ -119,4 +142,4 @@ help:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test lint bench chaos chaos-serve chaos-router chaos-proc serve-bench paged-bench spec-bench overload-bench router-bench trace-demo obs-bench help clean
+.PHONY: all build test lint bench chaos chaos-serve chaos-router chaos-proc chaos-heal serve-bench paged-bench spec-bench overload-bench router-bench heal-bench trace-demo obs-bench help clean

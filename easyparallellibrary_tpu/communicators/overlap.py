@@ -253,7 +253,8 @@ def reduce_scatter(x, axis_name: str, axis: int = 0, num_chunks: int = 0):
 def resolve_num_chunks(kind: str, axis_n: int, *,
                        m: int, k: int, n_out: int,
                        dtype=jnp.bfloat16,
-                       config=None) -> int:
+                       config=None,
+                       measured_collective_bytes=None) -> int:
   """Chunk count the ``communication.overlap`` policy picks for one
   collective-matmul site: 0/1 = fused, >= 2 = ring with that many
   chunks.
@@ -264,6 +265,9 @@ def resolve_num_chunks(kind: str, axis_n: int, *,
   ignored).  ``auto`` defers to the planner's analytic crossover
   (:func:`parallel.planner.plan_collective_matmul`, fed by the same
   flops/bytes quantities as the XLA cost-model path).
+  ``measured_collective_bytes`` feeds a profiler-measured wire-traffic
+  figure for this site into the crossover instead of the analytic
+  derivation (ROADMAP item 5c; the analytic model stays the fallback).
   """
   if axis_n <= 1:
     return 1
@@ -282,5 +286,6 @@ def resolve_num_chunks(kind: str, axis_n: int, *,
   decision = plan_collective_matmul(
       kind, m=m, k=k, n_out=n_out, axis_size=axis_n,
       dtype_bytes=jnp.dtype(dtype).itemsize,
-      num_chunks=requested)
+      num_chunks=requested,
+      measured_collective_bytes=measured_collective_bytes)
   return decision.num_chunks if decision.enabled else 1

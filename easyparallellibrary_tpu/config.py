@@ -536,6 +536,47 @@ class ServingConfig(_Category):
       # Deadline for a spawned child to import JAX, build its engine
       # from the factory, and answer the init frame.
       "router.spawn_timeout_s": 120.0,
+      # --- engine autotuner (serving/autotune.py, docs/robustness.md
+      # "Self-healing fleet").  An SLO-breach-driven actuator that moves
+      # DATA-VALUED knobs between fused steps — speculation-k clamp,
+      # prefill-budget clamp, effective slot cap, degradation-ladder
+      # floor — under the compile-once constraint (never a shape).
+      # Needs observability.slo.enabled to hear breaches.
+      "autotune.enabled": False,
+      # Clean engine steps (no matching breach) before the autotuner
+      # releases ONE level — hysteretic recovery mirroring the
+      # admission ladder, so a stale breach cannot pin the engine slow.
+      "autotune.hold_steps": 50,
+      # Highest tune level the autotuner may reach (1 = spec_trim,
+      # 2 = budget_tight, 3 = slot_cap; see serving/autotune.py).
+      "autotune.max_level": 3,
+      # Effective-slot-cap floor at the slot_cap level: the autotuner
+      # never clamps concurrency below this many slots.
+      "autotune.min_slots": 1,
+      # Prefill-budget clamp at budget_tight and above, in chunks:
+      # effective budget = budget_chunks * prefill_chunk.
+      "autotune.budget_chunks": 1,
+      # --- fleet autoscaler (serving/autoscale.py, docs/robustness.md
+      # "Self-healing fleet").  SLO-burn-driven replica-set policy over
+      # the router's existing drain()/rejoin()/add_replica() levers:
+      # grow on sustained fast+slow-window burn, shrink via graceful
+      # drain once the budget recovers.  Needs observability.slo.enabled.
+      "autoscale.enabled": False,
+      # Live-replica-set bounds (live = healthy + suspect).
+      "autoscale.min_replicas": 1,
+      "autoscale.max_replicas": 4,
+      # Cooldown after a scale-up before the next one (the base of the
+      # flap breaker's doubling hold-out), and the quiet period (no
+      # relevant breach) required before a scale-down.
+      "autoscale.scale_up_cooldown_s": 5.0,
+      "autoscale.scale_down_cooldown_s": 30.0,
+      # A scale-up this soon after a scale-down counts as a flap and
+      # doubles the scale-up hold-out (trip decay after a clean window),
+      # reusing the replica breaker's doubling-hold-out shape.
+      "autoscale.flap_window_s": 60.0,
+      # Extra SLO rule names (beyond every burn-rate rule, which always
+      # actuates) whose breaches trigger scale-up, e.g. "ttft_p99".
+      "autoscale.rules": (),
   }
 
   @property
@@ -553,6 +594,14 @@ class ServingConfig(_Category):
   @property
   def router(self) -> _SubGroup:
     return _SubGroup(self, "router")
+
+  @property
+  def autotune(self) -> _SubGroup:
+    return _SubGroup(self, "autotune")
+
+  @property
+  def autoscale(self) -> _SubGroup:
+    return _SubGroup(self, "autoscale")
 
 
 class ObservabilityConfig(_Category):
@@ -892,6 +941,32 @@ class Config:
       raise ValueError(f"serving.router.drain_timeout_s must be >= 0 "
                        f"(0 = migrate immediately); got "
                        f"{router.drain_timeout_s}")
+    tune = self.serving.autotune
+    if tune.hold_steps < 1:
+      raise ValueError(f"serving.autotune.hold_steps must be >= 1; "
+                       f"got {tune.hold_steps}")
+    if not 0 <= tune.max_level <= 3:
+      raise ValueError(f"serving.autotune.max_level must be in [0, 3]; "
+                       f"got {tune.max_level}")
+    if tune.min_slots < 1:
+      raise ValueError(f"serving.autotune.min_slots must be >= 1; "
+                       f"got {tune.min_slots}")
+    if tune.budget_chunks < 1:
+      raise ValueError(
+          f"serving.autotune.budget_chunks must be >= 1 (a smaller "
+          f"clamp could never afford any request's first chunk); got "
+          f"{tune.budget_chunks}")
+    scale = self.serving.autoscale
+    if not 1 <= scale.min_replicas <= scale.max_replicas:
+      raise ValueError(
+          f"serving.autoscale needs 1 <= min_replicas <= max_replicas; "
+          f"got min_replicas={scale.min_replicas}, "
+          f"max_replicas={scale.max_replicas}")
+    for field in ("scale_up_cooldown_s", "scale_down_cooldown_s",
+                  "flap_window_s"):
+      if getattr(scale, field) < 0:
+        raise ValueError(f"serving.autoscale.{field} must be >= 0; "
+                         f"got {getattr(scale, field)}")
 
   def to_dict(self) -> Dict[str, Dict[str, Any]]:
     return {c._name: getattr(self, c._name).to_dict()

@@ -37,7 +37,8 @@ import json
 import os
 import sys
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from easyparallellibrary_tpu.observability.registry import FLEET_NAMESPACE
 from easyparallellibrary_tpu.profiler.serving import percentile
@@ -249,6 +250,8 @@ def format_fleet(fleet: Dict[str, Any]) -> str:
       f"  control:    failovers {g('failovers'):.0f}, "
       f"migrated {g('migrated_requests'):.0f}, "
       f"probes {g('probes'):.0f}, parked {g('parked'):.0f}, "
+      f"scale-ups {g('scale_ups'):.0f} "
+      f"(-{g('scale_downs'):.0f} down), "
       f"requeues {g('requeues'):.0f}, "
       f"preemptions {g('preemptions'):.0f} "
       f"(+{g('proactive_preemptions'):.0f} proactive), "
@@ -278,6 +281,13 @@ class FollowState:
     # bounded — a follow session is meant to run for days, so it keeps
     # state per RULE STREAM, never per event).
     self.slo_state: Dict[str, Dict[str, Any]] = {}
+    # Self-healing actuations (serving/autotune.py / autoscale.py write
+    # "actuation" events into the same stream): total count plus the
+    # last few, so operators watch the control loop CLOSE — breach,
+    # knob moved old->new, recovery — in one panel.  Bounded like
+    # slo_state: a days-long follow keeps a tail, never every event.
+    self.actuation_count = 0
+    self.actuations: Deque[Dict[str, Any]] = deque(maxlen=4)
     self._polls = 0
 
   def _read_new_lines(self, path: str) -> List[Dict[str, Any]]:
@@ -323,6 +333,10 @@ class FollowState:
     if self.slo_path:
       for ev in self._read_new_lines(self.slo_path):
         changed = True
+        if ev.get("event") == "actuation":
+          self.actuation_count += 1
+          self.actuations.append(ev)
+          continue
         self.slo_breaches += ev.get("event") == "breach"
         key = f"{ev.get('rule', '?')}@{ev.get('metric', '-')}"
         self.slo_state[key] = ev
@@ -353,7 +367,28 @@ class FollowState:
           parts.append(f"{key}: {state}{detail}")
         lines.append(f"SLO [{self.slo_breaches} breach event(s)]: "
                      + "; ".join(parts))
+      if self.actuation_count:
+        lines.append(
+            f"actuations [{self.actuation_count} total]: "
+            + "; ".join(self._fmt_actuation(ev)
+                        for ev in self.actuations))
     return "\n".join(lines)
+
+  @staticmethod
+  def _fmt_actuation(ev: Dict[str, Any]) -> str:
+    """One actuation as ``actor: knob old->new (rule)`` — the knob
+    moved, its old and new value, and the breach that triggered it."""
+    actor = ev.get("actuator", ev.get("rule", "?"))
+    rule = ev.get("rule", "?")
+    knobs = ev.get("knobs") or {}
+    moves = [f"{k} {v[0]}->{v[1]}" for k, v in sorted(knobs.items())
+             if isinstance(v, (list, tuple)) and len(v) == 2]
+    if not moves and "from_level" in ev:
+      moves = [f"level {ev['from_level']}->{ev['to_level']}"]
+    if not moves and "action" in ev:
+      moves = [f"{ev['action']} replica {ev.get('replica', '?')}"]
+    return f"{actor}: {', '.join(moves) or ev.get('action', '?')} " \
+           f"(rule {rule})"
 
 
 def follow(metrics_path: str, slo_path: Optional[str] = None,

@@ -347,6 +347,8 @@ class SLOMonitor:
     self.wall_clock = wall_clock
     self.breaches = 0          # breach transitions + injected events
     self.recoveries = 0
+    self.actuations = 0        # note_actuation records (actuator layer)
+    self.listener_errors = 0   # raising listener callbacks, cumulative
     # (rule_name, key) -> {"breached": bool, "streak": int, "hist": deque}
     self._state: Dict[Tuple[str, str], Dict[str, Any]] = {}
     self.events: Deque[Dict[str, Any]] = deque(maxlen=history_limit)
@@ -370,9 +372,15 @@ class SLOMonitor:
                    weak: bool = False) -> None:
     """Subscribe ``fn(rule_name, payload)`` to every breach event.
     ``weak=True`` holds the bound method weakly (an engine subscribing
-    must stay collectible — the monitor is ambient and outlives it)."""
-    self._listeners.append(
-        weakref.WeakMethod(fn) if weak else (lambda _f=fn: _f))
+    must stay collectible — the monitor is ambient and outlives it).
+
+    Listener failures are ISOLATED: a raising callback is caught,
+    logged once per listener, counted (:attr:`listener_errors`, plus
+    the ``slo/listener_errors`` counter track), and never breaks
+    monitoring, the caller's step, or sibling listeners."""
+    self._listeners.append({
+        "ref": weakref.WeakMethod(fn) if weak else (lambda _f=fn: _f),
+        "logged": False})
 
   def add_context_provider(self, fn: Callable[[], Dict[str, Any]],
                            weak: bool = True) -> None:
@@ -396,6 +404,26 @@ class SLOMonitor:
     """Current per-stream state: ``{"rule@key": "breach"|"ok"}``."""
     return {f"{name}@{key}": ("breach" if st["breached"] else "ok")
             for (name, key), st in self._state.items()}
+
+  def breached_streams(self) -> List[Tuple[str, str]]:
+    """Currently-breached ``(rule_name, metric_key)`` streams — the
+    live-pressure view actuators poll between steps (a breach EVENT
+    fires only on the transition; sustained overload looks like a
+    stream that stays breached, serving/autotune.py)."""
+    return [(name, key) for (name, key), st in self._state.items()
+            if st["breached"]]
+
+  def breached_stream_obs(self) -> Dict[Tuple[str, str], int]:
+    """Observation counts for the currently-breached streams: how many
+    records each has EVER evaluated.  Actuators distinguish a live
+    sustained breach (records keep flowing, the count keeps growing —
+    hold/escalate mitigation) from a stale wedged one (an idle
+    engine's burn stream renders no verdict and the count freezes —
+    release mitigation) by watching this grow, since neither case
+    re-fires the transition event."""
+    return {(name, key): st.get("obs", 0)
+            for (name, key), st in self._state.items()
+            if st["breached"]}
 
   # --------------------------------------------------------- evaluation
 
@@ -433,7 +461,8 @@ class SLOMonitor:
         continue
       value = float(value)
       st = self._state.setdefault(
-          (rule.name, key), {"breached": False, "streak": 0})
+          (rule.name, key), {"breached": False, "streak": 0, "obs": 0})
+      st["obs"] = st.get("obs", 0) + 1
       if rule.healthy(value):
         st["streak"] = 0
         if st["breached"]:
@@ -463,8 +492,9 @@ class SLOMonitor:
         continue
       st = self._state.setdefault(
           (rule.name, bad_key),
-          {"breached": False, "streak": 0,
+          {"breached": False, "streak": 0, "obs": 0,
            "hist": deque(maxlen=rule.slow_window + 1)})
+      st["obs"] = st.get("obs", 0) + 1
       st["hist"].append((float(bad_v), float(good_v)))
       fast = rule.burn(st["hist"], rule.fast_window)
       slow = rule.burn(st["hist"], rule.slow_window)
@@ -497,6 +527,19 @@ class SLOMonitor:
     ``watchdog_timeout``.  Same three-way emission as a rule breach."""
     self._breach(name, step, dict(payload or {}), context=context)
 
+  def note_actuation(self, name: str,
+                     payload: Optional[Dict[str, Any]] = None,
+                     step: Optional[int] = None) -> None:
+    """Record one self-healing actuation (serving/autotune.py moved a
+    knob, serving/autoscale.py resized the replica set) as an
+    ``slo_events.jsonl`` line + ``slo/actuation`` trace instant — the
+    stream ``report.py --follow`` renders so operators watch the loop
+    close.  NOT a breach: no capture, no listener fan-out (an actuator
+    reacting to its own actuation would be a feedback loop), and the
+    breach counter is untouched."""
+    self.actuations += 1
+    self._emit("actuation", name, step, dict(payload or {}))
+
   def _breach(self, name: str, step: Optional[int],
               payload: Dict[str, Any],
               context: Optional[Dict[str, Any]] = None) -> None:
@@ -519,12 +562,38 @@ class SLOMonitor:
       if bundle is not None:
         payload["bundle"] = bundle
     self._emit("breach", name, step, payload)
-    for fn in self._collect(self._listeners):
+    self._notify(name, payload)
+
+  def _notify(self, name: str, payload: Dict[str, Any]) -> None:
+    """Deliver one breach to every live listener, isolating failures:
+    a raising subscriber is caught (the monitor, the engine step and
+    every SIBLING listener proceed), logged ONCE per listener (a
+    listener broken in a loop must not flood the log), and counted —
+    :attr:`listener_errors` plus a ``slo/listener_errors`` counter
+    track, so a silently-broken actuator is still visible."""
+    alive = []
+    errors_before = self.listener_errors
+    for entry in self._listeners:
+      fn = entry["ref"]()
+      if fn is None:
+        continue
+      alive.append(entry)
       try:
         fn(name, dict(payload))
       except Exception as e:  # noqa: BLE001 — a bad subscriber must not
-        get_logger().warning(                     # wedge the monitor
-            "SLO breach listener failed (%s: %s)", type(e).__name__, e)
+        self.listener_errors += 1                 # wedge the monitor
+        if not entry["logged"]:
+          entry["logged"] = True
+          get_logger().warning(
+              "SLO breach listener %r failed (%s: %s); listener kept, "
+              "logged once — see the slo/listener_errors counter",
+              getattr(fn, "__qualname__", fn), type(e).__name__, e)
+    self._listeners[:] = alive
+    if self.listener_errors != errors_before:
+      from easyparallellibrary_tpu.observability import trace as trace_lib
+      tracer = trace_lib.get_tracer()
+      if tracer.enabled:
+        tracer.counter("slo/listener_errors", self.listener_errors)
 
   def _emit(self, event: str, name: str, step: Optional[int],
             payload: Dict[str, Any]) -> None:
@@ -622,6 +691,47 @@ class CompileSentinel:
         get_logger().warning("compile-sentinel subscriber failed "
                              "(%s: %s)", type(e).__name__, e)
     return extra
+
+
+class BreachPressure:
+  """Liveness poll over a monitor's breached streams — the one place
+  the subtle actuator invariant lives (serving/autotune.py and
+  serving/autoscale.py both ride it): a breach EVENT fires only on the
+  transition, so sustained overload looks like a stream that stays
+  breached, and the only way to tell a LIVE sustained breach (keep
+  mitigating) from a stale wedged one (an idle engine's burn stream
+  renders no verdict — release mitigation) is whether the breached
+  streams' record counts are still growing.
+
+  ``match(rule_name, metric_key)`` selects the streams this probe
+  cares about.  :meth:`poll` returns ``(pressured, fresh)``:
+  ``pressured`` while any matching stream is breached, ``fresh`` when
+  any individual stream's observation count GREW (or a new breached
+  stream appeared) since the last poll — the caller refreshes its own
+  staleness clock (engine steps, wall time) on ``fresh``.  Freshness
+  is judged PER STREAM, never on an aggregate: one stream recovering
+  shrinks a sum without a single new record on the wedged survivors,
+  and must not read as life."""
+
+  def __init__(self, monitor: Optional[SLOMonitor],
+               match: Callable[[str, str], bool]):
+    self.monitor = monitor
+    self.match = match
+    self._counts: Dict[Tuple[str, str], int] = {}
+
+  def poll(self) -> Tuple[bool, bool]:
+    if self.monitor is None:
+      return False, False
+    current = {sk: count for sk, count
+               in self.monitor.breached_stream_obs().items()
+               if self.match(*sk)}
+    if not current:
+      self._counts = {}
+      return False, False
+    fresh = any(count > self._counts.get(sk, -1)
+                for sk, count in current.items())
+    self._counts = current
+    return True, fresh
 
 
 # ------------------------------------------------------ ambient monitor --

@@ -135,7 +135,9 @@ def plan_collective_matmul(kind: str, *, m: int, k: int, n_out: int,
                            link_bytes_per_s: float = DEFAULT_ICI_BYTES_PER_S,
                            step_latency_us: float = DEFAULT_STEP_LATENCY_US,
                            chunk_overhead_us: float =
-                           DEFAULT_CHUNK_OVERHEAD_US) -> OverlapDecision:
+                           DEFAULT_CHUNK_OVERHEAD_US,
+                           measured_collective_bytes: Optional[float] =
+                           None) -> OverlapDecision:
   """Analytic crossover for one decomposed-collective-matmul site.
 
   ``kind``: "all_gather_matmul" (x local [m, k] gathered then @ [k,
@@ -159,6 +161,18 @@ def plan_collective_matmul(kind: str, *, m: int, k: int, n_out: int,
   crossover — small matmuls, where per-step latency dominates the bytes
   it could hide — the model picks the fused program, which is why the
   ``auto`` policy is safe to leave on everywhere.
+
+  ``measured_collective_bytes`` replaces the analytically-derived wire
+  bytes with a PROFILER MEASUREMENT of THIS SITE's collective traffic
+  per step, so the crossover flips on from evidence instead of modeled
+  dims (ROADMAP item 5c: TPU crossovers need measured constants).  The
+  measurement must be site-scoped — e.g. ``profiler.flops.
+  collective_bytes`` over a lowering of just this decomposition site —
+  NOT a whole-program aggregate like ``FlopsProfiler``'s
+  ``comm_bytes_per_step``, which sums every collective in the step and
+  would inflate each site's comm time N-fold in an N-site program.
+  The analytic derivation stays the fallback when None/0 — same
+  decision shape, better inputs.
   """
   if kind not in ("all_gather_matmul", "matmul_reduce_scatter",
                   "reduce_scatter"):
@@ -187,6 +201,10 @@ def plan_collective_matmul(kind: str, *, m: int, k: int, n_out: int,
     # No adjacent matmul: what the ring hides is its neighbours' adds —
     # model the hideable compute as the local add stream.
     flops = float(m * k)
+
+  if measured_collective_bytes is not None and measured_collective_bytes > 0:
+    # Evidence wins over the analytic derivation (docstring).
+    wire_bytes = float(measured_collective_bytes)
 
   comm_us = wire_bytes / link_bytes_per_s * 1e6
   matmul_us = flops / peak_flops * 1e6

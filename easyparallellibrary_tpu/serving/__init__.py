@@ -25,6 +25,10 @@ from easyparallellibrary_tpu.serving.resilience import (
     DEGRADE_LEVELS, HEALTH_STATES, AdmissionController, BadStepPolicy,
     ReplicaHealth,
 )
+from easyparallellibrary_tpu.serving.autoscale import FleetAutoscaler
+from easyparallellibrary_tpu.serving.autotune import (
+    TUNE_LEVELS, EngineAutotuner,
+)
 from easyparallellibrary_tpu.serving.replica import EngineReplica
 from easyparallellibrary_tpu.serving.router import Router
 from easyparallellibrary_tpu.serving.transport import (
@@ -56,6 +60,7 @@ __all__ = [
     "AdmissionController", "BadStepPolicy", "DEGRADE_LEVELS",
     "FINISH_REASONS", "PRIORITIES",
     "EngineReplica", "HEALTH_STATES", "ReplicaHealth", "Router",
+    "EngineAutotuner", "FleetAutoscaler", "TUNE_LEVELS",
     "InprocTransport", "ProcessTransport", "RemoteError", "ReplicaDeadError",
     "ReplicaTransport", "TransportError", "TransportTimeout",
     "Drafter", "DraftModelDrafter", "NgramDrafter", "ngram_propose",

@@ -1,0 +1,336 @@
+"""Fleet-level SLO actuator: burn-rate breaches resize the live
+replica set through the router's existing levers.
+
+The serving stack already owns every mechanism this policy needs:
+graceful :meth:`Router.drain` / warm :meth:`Router.rejoin` (PR 8), a
+real process-spawn path behind :class:`ProcessTransport` (PR 11, now
+exposed as :meth:`Router.add_replica`), and the
+:class:`~easyparallellibrary_tpu.observability.slo.SLOMonitor`'s
+burn-rate rules that already prove a breach is sustained (fast AND slow
+window).  This module is only the policy that connects them:
+
+* **grow** — on a sustained SLO burn (any :class:`BurnRateRule` breach,
+  plus any rule named in ``serving.autoscale.rules``), add one replica:
+  a replica the autoscaler ITSELF previously drained rejoins WARM
+  (compiled step and cache intact — the cheapest capacity in the
+  fleet; an OPERATOR-drained replica is maintenance in progress and is
+  never silently reverted), else a new replica is built cold through
+  :meth:`Router.add_replica` (a REAL subprocess spawn on the process
+  transport — synchronous, like every router action: the sweep blocks
+  for the spawn, the same cost the breaker's respawn probe already
+  pays; an off-thread spawn with the replica unroutable until ready is
+  the ROADMAP follow-up);
+* **shrink** — once the error budget has recovered (no relevant breach
+  for ``scale_down_cooldown_s``), gracefully :meth:`drain` the
+  youngest-added live replica back out, never below ``min_replicas``;
+* **flap breaker** — a scale-up that lands inside ``flap_window_s`` of
+  a scale-down is a flap: each trip DOUBLES the scale-up hold-out
+  (capped at 2^6, decaying one trip per clean window) — the same
+  doubling-hold-out shape as PR 8's replica circuit breaker, so an
+  oscillating load curve converges to a steady set instead of paying a
+  cold spawn per wave.
+
+Actuations move only the replica SET — never a live engine's geometry —
+so every stream stays bit-exact and every replica's compile count stays
+1 (a cold spawn compiles its own step once, exactly like any restart).
+Each action emits a ``serving/actuation`` trace instant, an
+``slo_events.jsonl`` line (:meth:`SLOMonitor.note_actuation`), and the
+``scale_ups`` / ``scale_downs`` / ``autoscale_holds`` / ``flap_trips``
+counters on the ``serving/fleet/*`` rollup (published immediately, not
+on the heartbeat cadence — an actuation opens its evidence window at
+the action).
+
+Pure host policy — injectable clock (the router's), no jax; unit tests
+drive it with fake replicas and a fake clock
+(tests/test_serving_autoscale.py).  Knobs: ``serving.autoscale.*``
+(docs/robustness.md "Self-healing fleet").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.observability import trace as trace_lib
+from easyparallellibrary_tpu.utils.logging import get_logger
+
+# Flap hold-out doubling cap: 2^6 (mirrors ReplicaHealth.cooldown_s).
+_MAX_FLAP_DOUBLINGS = 6
+
+
+class FleetAutoscaler:
+  """SLO-burn-driven replica-set policy for one Router (module
+  docstring).  Built by the router when ``serving.autoscale.enabled``;
+  the router calls :meth:`on_step` at the top of every fleet sweep —
+  replica-list mutation is only safe between sweeps.
+
+  Threading mirrors the autotuner: breach callbacks may arrive from a
+  watchdog thread, so the listener only RECORDS under a lock and every
+  action happens in :meth:`on_step` on the router's thread.
+  """
+
+  def __init__(self, router, config=None):
+    conf = (config if config is not None
+            else Env.get().config).serving.autoscale
+    self.router = router
+    self.clock = router.clock
+    self.min_replicas = conf.min_replicas
+    self.max_replicas = conf.max_replicas
+    self.scale_up_cooldown_s = conf.scale_up_cooldown_s
+    self.scale_down_cooldown_s = conf.scale_down_cooldown_s
+    self.flap_window_s = conf.flap_window_s
+    self._rules = set(conf.rules)
+    self.scale_ups = 0
+    self.scale_downs = 0
+    self.holds = 0              # actions suppressed by cooldown/hold-out
+    self.flap_trips = 0
+    self.spawn_failures = 0
+    # Replica indices this policy currently OWNS (spawned or rejoined
+    # into service); shrink only ever drains from this set, and a
+    # drain moves the entry to _parked (eligible for warm rejoin) —
+    # the operator's base fleet is never the autoscaler's to take
+    # below its provisioned size, and an OPERATOR-drained replica is
+    # never its rejoin target.
+    self._added: List[int] = []
+    self._parked: List[int] = []
+    self._last_up_t: Optional[float] = None
+    self._last_down_t: Optional[float] = None
+    self._flap_decay_t: Optional[float] = None
+    self._lock = threading.Lock()
+    self._pending_rule: Optional[str] = None
+    self._last_breach_t: Optional[float] = None
+    monitor = router._slo
+    from easyparallellibrary_tpu.observability.slo import BreachPressure
+    self._probe = BreachPressure(
+        monitor, lambda rule, _key: rule in self._relevant_rules())
+    if monitor is not None:
+      monitor.add_listener(self._on_breach, weak=True)
+    else:
+      get_logger().warning(
+          "serving.autoscale.enabled without observability.slo.enabled: "
+          "the autoscaler has no burn signal and will never actuate")
+    if len(router.replicas) >= self.max_replicas:
+      get_logger().warning(
+          "serving.autoscale.max_replicas (%d) <= current fleet size "
+          "(%d): every scale-up will be held — raise max_replicas if "
+          "the fleet should grow under burn", self.max_replicas,
+          len(router.replicas))
+    get_logger().info(
+        "fleet autoscaler: %d..%d replicas, up/down cooldown "
+        "%.1fs/%.1fs, flap window %.1fs, extra rules %s",
+        self.min_replicas, self.max_replicas, self.scale_up_cooldown_s,
+        self.scale_down_cooldown_s, self.flap_window_s,
+        sorted(self._rules) or "(burn rules only)")
+
+  # ----------------------------------------------------------- listening
+
+  def _on_breach(self, rule: str, payload: Dict[str, Any]) -> None:
+    """Record a relevant breach.  Burn-rate breaches (payload carries
+    the window burns) always qualify — the rule itself proved the burn
+    is sustained across fast AND slow windows; threshold rules only
+    when named in ``serving.autoscale.rules``."""
+    if "fast_burn" not in payload and rule not in self._rules:
+      return
+    with self._lock:
+      self._pending_rule = rule
+      self._last_breach_t = self.clock()
+
+  # ------------------------------------------------------------- policy
+
+  def _live(self) -> List[int]:
+    """Replica indices serving or able to serve (healthy + suspect);
+    draining and down replicas are capacity already removed."""
+    return [i for i, h in enumerate(self.router.health)
+            if h.state in ("healthy", "suspect")]
+
+  def _relevant_rules(self) -> set:
+    monitor = self.router._slo
+    if monitor is None:
+      return set(self._rules)
+    from easyparallellibrary_tpu.observability.slo import BurnRateRule
+    return ({r.name for r in monitor.rules
+             if isinstance(r, BurnRateRule)} | self._rules)
+
+  def _pressure(self) -> bool:
+    """Is any relevant breach stream STILL breached?  A breach event
+    fires only on the transition; an overload one replica-add did not
+    absorb looks like a burn stream that never recovers, so sustained
+    pressure is polled (slo.BreachPressure owns the liveness
+    invariant).  While the breach is alive ``_last_breach_t``
+    refreshes, so the quiet-window gates below never read a live burn
+    as recovered; a wedged stream whose records stopped flowing lets
+    the timestamp age out."""
+    pressured, fresh = self._probe.poll()
+    if fresh:
+      with self._lock:
+        self._last_breach_t = self.clock()
+    return pressured
+
+  def scale_up_holdout_s(self) -> float:
+    """Current scale-up hold-out: the base cooldown doubled per flap
+    trip (capped) — PR 8's breaker shape applied to capacity."""
+    return self.scale_up_cooldown_s * (
+        2 ** min(self.flap_trips, _MAX_FLAP_DOUBLINGS))
+
+  def on_step(self, now: Optional[float] = None) -> None:
+    """One fleet-sweep boundary: act on a recorded breach (grow), or on
+    a recovered budget (shrink), honoring bounds/cooldowns/hold-outs."""
+    now = self.clock() if now is None else now
+    if self._parked:
+      # A parked claim is valid only while the drain THIS policy
+      # started is still in effect: the moment a parked replica leaves
+      # "draining" through any other path (an operator rejoined it,
+      # or it died), the claim is void — otherwise a LATER operator
+      # maintenance drain of the same index would read as ours and a
+      # breach could silently revert it.
+      self._parked = [i for i in self._parked
+                      if self.router.health[i].state == "draining"]
+    with self._lock:
+      rule, self._pending_rule = self._pending_rule, None
+    if rule is not None:
+      self._maybe_scale_up(rule, now)
+      return
+    # _pressure() refreshes _last_breach_t while the breached streams'
+    # records keep flowing — a live sustained burn keeps the quiet
+    # window open; a wedged-silent stream lets it close (stale escape).
+    pressured = self._pressure()
+    with self._lock:
+      last_breach_t = self._last_breach_t
+    if (pressured and last_breach_t is not None
+        and now - last_breach_t < self.scale_down_cooldown_s):
+      # Sustained burn one add did not absorb: keep growing, one
+      # replica per hold-out window (the checks here pre-gate so the
+      # holds counter only counts suppressed breach EVENTS).
+      if (len(self._live()) < self.max_replicas
+          and (self._last_up_t is None
+               or now - self._last_up_t >= self.scale_up_holdout_s())):
+        self._maybe_scale_up("sustained", now)
+      return
+    # Flap-trip decay: a full clean window without any scaling action
+    # forgives one trip (ReplicaHealth.note_stable's analogue).
+    if self.flap_trips:
+      quiet = max(self._last_up_t or 0.0, self._last_down_t or 0.0,
+                  self._flap_decay_t or 0.0)
+      if now - quiet >= self.flap_window_s:
+        self.flap_trips -= 1
+        self._flap_decay_t = now   # one forgiveness per clean window
+    if not self._added or last_breach_t is None:
+      # Nothing autoscaler-owned in service: the operator's base set
+      # is never drained — min_replicas is a floor, not a target.
+      return
+    quiet_since = max(
+        last_breach_t, self._last_up_t or 0.0, self._last_down_t or 0.0)
+    if now - quiet_since >= self.scale_down_cooldown_s:
+      self._maybe_scale_down(now)
+
+  def _maybe_scale_up(self, rule: str, now: float) -> None:
+    live = self._live()
+    if len(live) >= self.max_replicas:
+      self.holds += 1
+      return
+    if (self._last_up_t is not None
+        and now - self._last_up_t < self.scale_up_holdout_s()):
+      self.holds += 1
+      return
+    flapped = (self._last_down_t is not None
+               and now - self._last_down_t < self.flap_window_s)
+    router = self.router
+    # Cheapest capacity first: a replica THIS policy drained rejoins
+    # WARM.  Operator-drained replicas are maintenance in progress —
+    # reverting one on a breach would silently undo a rolling restart.
+    parked = [i for i in self._parked
+              if router.health[i].state == "draining"]
+    if parked:
+      index = parked[-1]
+      if not router.rejoin(index):
+        self.holds += 1
+        return
+      self._parked.remove(index)
+      action = "rejoin"
+    else:
+      try:
+        index = router.add_replica()
+      except Exception as e:  # noqa: BLE001 — a failed spawn must not
+        self.spawn_failures += 1          # take the control plane down
+        get_logger().error(
+            "autoscale: replica spawn failed (%s: %s); holding",
+            type(e).__name__, e)
+        # Stamp AFTER the failed attempt (same rule as the success
+        # path): a spawn that blocked until spawn_timeout_s must buy a
+        # full cooldown of actual serving before the retry, not an
+        # immediate back-to-back doomed attempt.
+        self._last_up_t = self.clock()
+        return
+      action = "spawn"
+    if index not in self._added:
+      # Autoscaler-owned capacity (spawned OR rejoined into service):
+      # exactly the set shrink may later drain back out.
+      self._added.append(index)
+    if flapped:
+      # Growing right after shrinking — and only when the grow actually
+      # LANDED: the load is oscillating around the capacity step, so
+      # the next hold-out doubles (a failed spawn is not a flap).
+      self.flap_trips = min(self.flap_trips + 1, _MAX_FLAP_DOUBLINGS)
+    self.scale_ups += 1
+    # Stamp AFTER the action: a cold spawn blocks for seconds, and a
+    # cooldown counted from before it would let the very next sweep
+    # read the whole spawn as "quiet" and drain the replica right back.
+    self._last_up_t = self.clock()
+    self._emit("scale_up", action, index, rule)
+
+  def _maybe_scale_down(self, now: float) -> None:
+    live = self._live()
+    if len(live) <= self.min_replicas:
+      return
+    # Youngest-added live replica, LIFO — and ONLY autoscaler-owned
+    # capacity: if everything it added is already gone (e.g. the
+    # spawned replica died), the operator's base set is not a fallback.
+    added_live = [i for i in self._added if i in live]
+    if not added_live:
+      return
+    index = added_live[-1]
+    self._added.remove(index)
+    self._parked.append(index)   # eligible for a future warm rejoin
+    self.router.drain(index)
+    self.scale_downs += 1
+    self._last_down_t = self.clock()
+    self._emit("scale_down", "drain", index, "recovered")
+
+  # ------------------------------------------------------------ emission
+
+  def counters(self) -> Dict[str, float]:
+    """Fleet-rollup counters (merged into Router.router_counters, so
+    they ride the ``serving/fleet/*`` schema with zero new plumbing)."""
+    return {"scale_ups": float(self.scale_ups),
+            "scale_downs": float(self.scale_downs),
+            "autoscale_holds": float(self.holds),
+            "flap_trips": float(self.flap_trips)}
+
+  def _emit(self, action: str, mechanism: str, index: int,
+            rule: str) -> None:
+    router = self.router
+    live = len(self._live())
+    payload = {"actuator": "autoscale", "action": action,
+               "mechanism": mechanism, "replica": int(index),
+               "rule": rule, "live_replicas": live,
+               "knobs": {"live_replicas":
+                         [live - 1 if action == "scale_up" else live + 1,
+                          live]}}
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.instant(
+          "serving/actuation", cat="serving", track="serving",
+          args={"actuator": "autoscale", "action": action,
+                "mechanism": mechanism, "replica": int(index),
+                "rule": rule, "live_replicas": live})
+      tracer.counter("serving/live_replicas", live)
+    if router._slo is not None:
+      router._slo.note_actuation("autoscale", payload, step=router.steps)
+    # Immediate rollup: the actuation's counter evidence lands at the
+    # action, not up to a heartbeat later (Router._note_incident's rule).
+    router._note_incident()
+    get_logger().warning(
+        "autoscale: %s replica %d via %s (rule %s) -> %d live "
+        "(trips %d, next hold-out %.1fs)", action, index, mechanism,
+        rule, live, self.flap_trips, self.scale_up_holdout_s())

@@ -427,6 +427,16 @@ class ContinuousBatchingEngine:
       self._slo.add_context_provider(self._capture_context)
       if self._capture_xla:
         self._slo.add_listener(self._arm_xla_capture, weak=True)
+    # Engine-level SLO actuator (serving/autotune.py; docs/robustness.md
+    # "Self-healing fleet"): breaches move data-valued knobs between
+    # steps — speculation-k / prefill-budget / slot-cap clamps and the
+    # admission-ladder floor — with hysteretic recovery.  Never a shape:
+    # the compile-once contract is the actuator's hard constraint.
+    self._autotuner = None
+    if conf.autotune.enabled:
+      from easyparallellibrary_tpu.serving.autotune import EngineAutotuner
+      self._autotuner = EngineAutotuner(self, self._slo,
+                                        config=root_config)
     if self.paged:
       layout = (f"paged: {self.num_blocks} x {self.block_size}-token "
                 f"blocks, token budget {self.token_budget}, "
@@ -543,6 +553,9 @@ class ContinuousBatchingEngine:
     if self._admission is not None:
       ctx["degraded_level"] = self._admission.level
       ctx["shed_total"] = self._admission.shed_total
+    if self._autotuner is not None:
+      ctx["autotune_level"] = self._autotuner.level
+      ctx["autotune_actuations"] = self._autotuner.actuations
     if self._bad_policy is not None:
       ctx.update(self._bad_policy.counters())
     if self.paged:
@@ -912,7 +925,10 @@ class ContinuousBatchingEngine:
     max_batch < num_slots the batch saturates below full slot count,
     and budget_tight's occupancy gate must still be reachable."""
     itl = self.stats.itl_ewma_s if self.stats is not None else 0.0
-    cap = min(self.num_slots, self.scheduler.max_batch)
+    # The autotuner's slot-cap clamp shrinks effective concurrency;
+    # occupancy (and with it budget_tight's gate) is judged against
+    # the cap actually in force.
+    cap = min(self.num_slots, self.scheduler.effective_max_batch)
     self._admission.observe(
         self.scheduler.queue_depth,
         self.scheduler.num_active / cap, itl)
@@ -1074,6 +1090,10 @@ class ContinuousBatchingEngine:
     that retired this iteration (empty when idle), expiries and
     cancellations included."""
     tracer = trace_lib.get_tracer()
+    if self._autotuner is not None:
+      # Knob moves land HERE — strictly between fused-step dispatches,
+      # steering the plan built just below (compile-once: data only).
+      self._autotuner.on_step(self._steps)
     with tracer.span("serving/plan", cat="serving", track="serving"):
       plan = self.scheduler.plan_step()
     if self._admission is not None:
@@ -1265,6 +1285,19 @@ class ContinuousBatchingEngine:
         record["degraded_level"] = self._admission.level
         record["shed"] = self._admission.shed_total
         record.update(self._bad_policy.counters())
+        if self.stats is not None:
+          # The cumulative good-counter partner of "shed", so burn-rate
+          # rules (bad="shed", good="finished_requests") evaluate on
+          # every per-step record — not only on the sparse percentile
+          # rollups — and an overloaded engine's own monitor breaches
+          # while the overload is still happening.
+          record["finished_requests"] = float(
+              self.stats.finished_requests)
+      if self._autotuner is not None:
+        # Actuator evidence rides the existing serving/* schema: the
+        # current tune level and cumulative actuation count per step.
+        record["autotune_level"] = self._autotuner.level
+        record["autotune_actuations"] = self._autotuner.actuations
       if self.metrics_writer is not None:
         # Legacy flat keys (pre-registry callers depend on them).
         self.metrics_writer.write(self._steps, record)

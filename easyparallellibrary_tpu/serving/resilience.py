@@ -110,6 +110,11 @@ class AdmissionController:
     self.degrade_queue_frac = degrade_queue_frac
     self.on_transition = on_transition
     self.level = 0
+    # External de-escalation floor (the autotuner's ladder-floor knob,
+    # serving/autotune.py): while set, the ladder never drops below it
+    # — an SLO actuator can pin "at least spec_off" through a breach
+    # window without re-deriving the queue signals.  0 = no floor.
+    self.floor_level = 0
     self.transitions = 0
     self.shed_total = 0
 
@@ -135,7 +140,7 @@ class AdmissionController:
         level = 1
     if itl_over:
       level = max(level, 1)
-    return level
+    return max(level, min(self.floor_level, 3))
 
   def observe(self, queue_depth: int, occupancy: float,
               itl_s: float = 0.0) -> int:

@@ -123,9 +123,12 @@ class Router:
                transport=None, **engine_kwargs):
     root_config = config if config is not None else Env.get().config
     rconf = root_config.serving.router
+    self._root_config = root_config
     self._drain_timeout_s = rconf.drain_timeout_s
     self._affinity_enabled = rconf.affinity
     self._heartbeat_s = rconf.heartbeat_s
+    self._suspect_after = rconf.suspect_after
+    self._down_after = rconf.down_after
     self.clock = clock
     # Ambient SLO monitor (observability/slo.py): the router feeds it
     # the live fleet rollup — every heartbeat interval, and immediately
@@ -135,6 +138,10 @@ class Router:
     self._last_rollup = clock()
     self.transport = (transport if transport is not None
                       else rconf.transport)
+    # Everything add_replica() needs to build one more fleet member —
+    # the autoscaler's cold scale-up path.  Injected (test) replica
+    # lists carry no recipe, so the fleet cannot grow there.
+    self._replica_spec: Optional[Dict[str, Any]] = None
     if replicas is not None:
       self.replicas: List[EngineReplica] = list(replicas)
       self.transport = "injected"
@@ -163,15 +170,13 @@ class Router:
                             registry=registry, config=root_config,
                             **engine_kwargs)
             for i in range(n)]
-    itl_slo = root_config.serving.resilience.itl_slo_s
+      self._replica_spec = {
+          "model": model, "params": params, "mesh": mesh,
+          "registry": registry, "factory": factory,
+          "engine_kwargs": dict(engine_kwargs)}
+    self._itl_slo = root_config.serving.resilience.itl_slo_s
     self.health: List[ReplicaHealth] = [
-        ReplicaHealth(
-            suspect_after=rconf.suspect_after,
-            down_after=rconf.down_after,
-            heartbeat_s=rconf.heartbeat_s, itl_slo_s=itl_slo,
-            clock=clock,
-            on_transition=self._make_health_hook(i))
-        for i in range(len(self.replicas))]
+        self._make_health(i) for i in range(len(self.replicas))]
     self.registry = registry
     if self._slo is not None and registry is not None:
       self._slo.attach(registry)
@@ -195,6 +200,15 @@ class Router:
     self.migrated_requests = 0       # snapshots moved (failover + drain)
     self.router_shed = 0             # shed here: no routable replica
     self.probes = 0                  # breaker half-open rejoins
+    # Fleet-level SLO actuator (serving/autoscale.py): SLO-burn-driven
+    # grow/shrink of the live replica set through drain/rejoin and the
+    # add_replica spawn path below.  Acts at step() start only —
+    # replica-list mutation mid-sweep is never safe.
+    self._autoscaler = None
+    if root_config.serving.autoscale.enabled:
+      from easyparallellibrary_tpu.serving.autoscale import (
+          FleetAutoscaler)
+      self._autoscaler = FleetAutoscaler(self, config=root_config)
     get_logger().info(
         "serving router: %d replica(s), suspect/down after %.1fs/%.1fs, "
         "drain timeout %.1fs, affinity %s", len(self.replicas),
@@ -202,6 +216,52 @@ class Router:
         "on" if self._affinity_enabled else "off")
 
   # ------------------------------------------------------------- health
+
+  def _make_health(self, index: int) -> ReplicaHealth:
+    return ReplicaHealth(
+        suspect_after=self._suspect_after, down_after=self._down_after,
+        heartbeat_s=self._heartbeat_s, itl_slo_s=self._itl_slo,
+        clock=self.clock, on_transition=self._make_health_hook(index))
+
+  def add_replica(self) -> int:
+    """Grow the fleet by ONE replica built from the construction recipe
+    (same transport, config and engine kwargs as the originals);
+    returns its index.  On the process transport this is a REAL
+    subprocess spawn — the child builds its own engine and compiles its
+    own fused step once, exactly what a capacity add costs.  The parked
+    backlog flushes immediately: new capacity must serve, not idle.
+
+    The autoscaler's cold scale-up path (serving/autoscale.py); also an
+    operator lever.  Raises on a fleet built from injected replicas
+    (tests) — there is no recipe to build from."""
+    if self._replica_spec is None:
+      raise RuntimeError(
+          "add_replica() needs a router that built its own replicas; "
+          "a fleet constructed from injected replicas carries no "
+          "(model, params)/factory recipe to grow from")
+    spec = self._replica_spec
+    index = len(self.replicas)
+    if self.transport == "process":
+      rep: Any = ProcessTransport(
+          index, spec["factory"], config=self._root_config,
+          engine_kwargs=spec["engine_kwargs"])
+    else:
+      rep = InprocTransport(
+          index, spec["model"], spec["params"], mesh=spec["mesh"],
+          registry=spec["registry"], config=self._root_config,
+          **spec["engine_kwargs"])
+    self.replicas.append(rep)
+    self.health.append(self._make_health(index))
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.instant(
+          "serving/replica_added", cat="serving", track="serving",
+          args={"replica": index, "transport": self.transport,
+                "pid": getattr(rep, "child_pid", None) or -1})
+    get_logger().info("fleet grew: replica %d added (%s transport)",
+                      index, self.transport)
+    self._flush_parked()
+    return index
 
   def _make_health_hook(self, index: int):
     def hook(old: str, new: str, reason: str):
@@ -416,6 +476,10 @@ class Router:
     retirements fleet-wide."""
     now = self.clock()
     out: List[FinishedRequest] = []
+    if self._autoscaler is not None:
+      # Replica-set actuation happens HERE, before the sweep touches
+      # the list — a mid-sweep grow/drain would race the phase loops.
+      self._autoscaler.on_step(now)
     self._check_drains(now)
     self._flush_parked()
     # Phase 1 — dispatch: process transports get their step frame NOW,
@@ -813,6 +877,10 @@ class Router:
         "rpc_timeouts": 0.0,
         "child_restarts": 0.0,
     }
+    if self._autoscaler is not None:
+      # Actuator counters ride the same fleet rollup (scale_ups,
+      # scale_downs, autoscale_holds, flap_trips).
+      counters.update(self._autoscaler.counters())
     for rep in self.replicas:
       rpc = getattr(rep, "rpc_counters", None)
       if rpc is None:

@@ -410,6 +410,15 @@ class FCFSScheduler:
     # Temporary degradation override (engine resilience): when > 0 the
     # effective per-step budget is min(budget or inf, override).
     self.budget_override = 0
+    # Autotuner clamps (serving/autotune.py) — all DATA-valued: they
+    # steer host-side planning/admission only, so moving them between
+    # steps never changes a fused-step shape.  tune_budget (>0) joins
+    # the budget min above; tune_slot_cap (>0) caps effective
+    # concurrency below max_batch; tune_spec_k (>=0) caps per-slot
+    # draft length below spec_k (0 = no drafts planned).
+    self.tune_budget = 0
+    self.tune_slot_cap = 0
+    self.tune_spec_k = -1
     self.max_batch = max_batch if max_batch > 0 else num_slots
     self.default_stop_token = stop_token
     self.clock = clock
@@ -438,11 +447,30 @@ class FCFSScheduler:
     self.on_finish: List[Callable[[FinishedRequest], None]] = []
 
   def _effective_budget(self) -> int:
-    if self.budget_override > 0:
-      if self.prefill_token_budget > 0:
-        return min(self.prefill_token_budget, self.budget_override)
-      return self.budget_override
-    return self.prefill_token_budget
+    # Branches, not a list build: this runs twice per engine step on
+    # the host hot path.
+    budget = self.prefill_token_budget
+    if self.budget_override > 0 and \
+        (budget == 0 or self.budget_override < budget):
+      budget = self.budget_override
+    if self.tune_budget > 0 and (budget == 0 or self.tune_budget < budget):
+      budget = self.tune_budget
+    return budget
+
+  @property
+  def effective_max_batch(self) -> int:
+    """Concurrency cap after the autotuner's slot-cap clamp (admission
+    reads this; ``max_batch`` stays the configured baseline)."""
+    if self.tune_slot_cap > 0:
+      return min(self.max_batch, self.tune_slot_cap)
+    return self.max_batch
+
+  @property
+  def effective_spec_k(self) -> int:
+    """Per-slot draft cap after the autotuner's speculation clamp."""
+    if self.tune_spec_k >= 0:
+      return min(self.spec_k, self.tune_spec_k)
+    return self.spec_k
 
   # ---------------------------------------------------------------- queue
 
@@ -813,6 +841,7 @@ class FCFSScheduler:
     more prefill work than it can schedule — an admitted-but-starved
     request would hold a slot while contributing nothing."""
     budget_cap = self._effective_budget()
+    batch_cap = self.effective_max_batch   # hoisted: loop-invariant
     budget_left = budget_cap
     if budget_left > 0:
       # Already-active prefill slots have first claim on the budget.
@@ -826,7 +855,7 @@ class FCFSScheduler:
       if budget_cap > 0 and budget_left < first_chunk:
         break
       if (self.allocator.num_free == 0
-          or len(self.active) >= self.max_batch):
+          or len(self.active) >= batch_cap):
         # Capacity-blocked.  Proactive latency-class preemption (paged
         # engine): a latency arrival next in line evicts the youngest
         # throughput slot holding blocks NOW rather than queueing until
@@ -1106,7 +1135,8 @@ class FCFSScheduler:
       pos += grant
       scheduled.add(slot)
     # Pass 3: speculative draft reservations ride the leftover budget.
-    if self.spec_k > 0 and self.spec_enabled:
+    spec_k = self.effective_spec_k
+    if spec_k > 0 and self.spec_enabled:
       for slot in list(self._admit_order):
         state = self.active.get(slot)
         if (state is None or state.prefilling
@@ -1114,7 +1144,7 @@ class FCFSScheduler:
             or state.req.speculative is False):
           continue
         remaining = state.req.max_new_tokens - len(state.generated)
-        cap = max(0, min(self.spec_k, remaining - 1, T - pos))
+        cap = max(0, min(spec_k, remaining - 1, T - pos))
         if cap <= 0:
           continue
         dec_pos = int(plan.positions[plan.base_idx[slot]])
@@ -1186,6 +1216,7 @@ class FCFSScheduler:
         prefill_tokens=0, decode_tokens=0,
         active_slots=len(self.active))
     budget = self._effective_budget()
+    spec_k = self.effective_spec_k        # hoisted: loop-invariant
     for slot in self._admit_order:
       state = self.active.get(slot)
       if state is None:
@@ -1215,13 +1246,13 @@ class FCFSScheduler:
         plan.tokens[slot, 0] = state.generated[-1]
         plan.num_valid[slot] = 1
         plan.decode_tokens += 1
-        if (self.spec_k > 0 and self.spec_enabled
+        if (spec_k > 0 and self.spec_enabled
             and req.speculative is not False):
           # Drafting past the request's remaining budget is pure waste:
           # at most (remaining - 1) drafts can commit alongside the
           # step's guaranteed token.
           remaining = req.max_new_tokens - len(state.generated)
-          plan.draft_cap[slot] = max(0, min(self.spec_k, remaining - 1))
+          plan.draft_cap[slot] = max(0, min(spec_k, remaining - 1))
     self._plan = plan
     return plan
 

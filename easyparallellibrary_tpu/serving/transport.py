@@ -402,6 +402,7 @@ class ProcessTransport(ReplicaTransport):
     self.rpc_retries_total = 0
     self.rpc_timeouts_total = 0
     self.child_restarts = 0
+    self.last_spawn_s = 0.0     # spawn-to-ready wall time (start())
     self._proc: Optional[subprocess.Popen] = None
     self._sock: Optional[socket.socket] = None
     self._reader: Optional[FrameReader] = None
@@ -440,9 +441,14 @@ class ProcessTransport(ReplicaTransport):
   def start(self) -> None:
     """Spawn the child, hand it the socketpair end, and block until its
     engine is built (``ready``).  The child process is registered with
-    the atexit reaper before anything can fail past the spawn."""
+    the atexit reaper before anything can fail past the spawn.
+    ``last_spawn_s`` records the spawn-to-ready wall time — the cold
+    capacity cost the autoscaler's scale-up actuation pays
+    (serving/autoscale.py), surfaced so operators can weigh warm rejoin
+    against cold spawn from evidence."""
     if self.alive:
       return
+    t_spawn = time.monotonic()
     parent_sock, child_sock = socket.socketpair()
     try:
       env = dict(os.environ)
@@ -489,9 +495,11 @@ class ProcessTransport(ReplicaTransport):
       self._fence()
       raise
     info = reply.get("result") or {}
+    self.last_spawn_s = time.monotonic() - t_spawn
     get_logger().info(
-        "replica %d: process transport up (pid %d, backend %s)",
-        self.index, self._proc.pid, info.get("platform", "?"))
+        "replica %d: process transport up (pid %d, backend %s, "
+        "spawn %.1fs)", self.index, self._proc.pid,
+        info.get("platform", "?"), self.last_spawn_s)
 
   def ensure_started(self) -> bool:
     """Respawn a dead/condemned child (breaker probe, operator rejoin).

@@ -598,3 +598,28 @@ def poisson_trace(rate_per_s: float, n: int, seed: int = 0,
   if first_at_zero:
     gaps[0] = 0.0
   return np.cumsum(gaps)
+
+
+def overload_burst(service_rate_per_s: float, n_burst: int,
+                   n_recover: int, factor: float = 3.0,
+                   recover_frac: float = 0.5,
+                   seed: int = 0) -> np.ndarray:
+  """Arrival offsets for a self-healing episode (``make chaos-heal``,
+  tests/test_serving_autoscale.py): ``n_burst`` Poisson arrivals at
+  ``factor`` x the sustainable service rate — the overload that must
+  breach the SLO burn rules and fire the actuators — followed by
+  ``n_recover`` arrivals back at ``recover_frac`` x the service rate,
+  the quiet tail that lets the error budget recover so hysteretic
+  de-escalation and scale-down can be observed in the SAME trace.
+  One seeded stream end to end, so the episode is reproducible."""
+  if factor <= 1.0:
+    raise ValueError(f"factor must be > 1 (an overload): {factor}")
+  if not 0 < recover_frac <= 1.0:
+    raise ValueError(f"recover_frac must be in (0, 1]: {recover_frac}")
+  rng = np.random.RandomState(seed)
+  burst = poisson_trace(service_rate_per_s * factor, n_burst, rng=rng)
+  if n_recover <= 0:
+    return burst
+  tail = poisson_trace(service_rate_per_s * recover_frac, n_recover,
+                       rng=rng, first_at_zero=False)
+  return np.concatenate([burst, burst[-1] + tail])

@@ -152,6 +152,36 @@ def test_host_sync_covers_transport_module(tmp_path):
   assert [f.line for f in findings] == [8]
 
 
+def test_host_sync_covers_actuator_modules(tmp_path):
+  """The self-healing actuators (ISSUE 13) are hot-path for epl-lint:
+  the SHIPPED serving/autotune.py and serving/autoscale.py scan as hot
+  (their breach handlers run inside the serving loop — an implicit
+  device->host fetch a future edit introduces there is a finding, and
+  the shipped baseline stays empty; the quick zero-findings acceptance
+  below enforces that), pinned against a fixture twin so a marker
+  refactor cannot silently drop them."""
+  from easyparallellibrary_tpu.analysis.core import ModuleInfo
+  from easyparallellibrary_tpu.analysis.rules import _is_hot
+  pkg = package_root()
+  for rel in ("serving/autotune.py", "serving/autoscale.py"):
+    shipped = os.path.join(pkg, rel)
+    assert os.path.exists(shipped)
+    assert _is_hot(ModuleInfo(path=shipped, rel=rel, source="",
+                              tree=None, parse_error=None)), rel
+  path = _write(tmp_path, "serving/autotune.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def on_breach(payload):
+        return float(np.asarray(_fn(payload)))
+      """)
+  findings = _by_rule(_run(path), "host-sync")
+  assert [f.line for f in findings] == [8]
+
+
 def test_host_sync_flags_implicit_bool_and_float(tmp_path):
   _write(tmp_path, "runtime/loop.py", """\
       def fit(step_fn, state, batch):

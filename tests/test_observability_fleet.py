@@ -339,6 +339,111 @@ def test_burn_rate_rule_fast_and_slow_windows():
   assert m.recoveries == 1
 
 
+def test_raising_listener_is_isolated_logged_once_and_counted():
+  """ISSUE 13 satellite: a raising listener callback is caught, logged
+  ONCE per listener, counted (slo/listener_errors), and never breaks
+  monitoring, the caller's step, or SIBLING listeners — today's
+  actuators subscribe here, and one bad subscriber must not take the
+  serving loop down with it."""
+  import logging
+
+  from easyparallellibrary_tpu.utils.logging import get_logger
+  m = SLOMonitor([SLORule("ttft", "ttft_p99_s", "<=", 0.1)])
+  heard = []
+
+  def bad_listener(name, payload):
+    raise RuntimeError("chaos: broken subscriber")
+
+  m.add_listener(bad_listener)
+  m.add_listener(lambda name, payload: heard.append(name))
+  captured = []
+
+  class _Capture(logging.Handler):
+    def emit(self, record):
+      captured.append(record.getMessage())
+
+  handler = _Capture()
+  get_logger().addHandler(handler)  # the package logger: propagate off
+  try:
+    for step in range(4):
+      # Breach -> recover -> breach -> recover: two breach deliveries.
+      m.observe(step, {"serving/ttft_p99_s": 9.0 if step % 2 == 0
+                       else 0.01})
+  finally:
+    get_logger().removeHandler(handler)
+  assert m.breaches == 2
+  # The sibling heard EVERY breach despite the raiser running first.
+  assert heard == ["ttft", "ttft"]
+  assert m.listener_errors == 2
+  # Logged once per listener, not once per failure.
+  logged = [msg for msg in captured
+            if "listener" in msg and "broken subscriber" in msg]
+  assert len(logged) == 1
+  # note_event breaches go through the same isolation.
+  m.note_event("watchdog_timeout", {"twin": "serving/fused_step"})
+  assert m.listener_errors == 3 and heard[-1] == "watchdog_timeout"
+
+
+def test_follow_renders_actuation_events(tmp_path):
+  """ISSUE 13 satellite: report --follow shows actuations (knob moved,
+  old->new value, triggering rule) in the live SLO panel."""
+  metrics = tmp_path / "metrics.jsonl"
+  slo = tmp_path / "slo_events.jsonl"
+  metrics.write_text("")
+  m = SLOMonitor([], events_path=str(slo))
+  m.note_actuation("autotune", {
+      "actuator": "autotune", "rule": "shed_burn",
+      "from_level": "normal", "to_level": "spec_trim",
+      "knobs": {"tune_spec_k": [-1, 2]}}, step=7)
+  m.note_actuation("autoscale", {
+      "actuator": "autoscale", "action": "scale_up", "replica": 2,
+      "rule": "shed_burn", "knobs": {"live_replicas": [2, 3]}},
+      step=9)
+  m.close()
+  assert m.actuations == 2
+  st = report.FollowState(str(metrics), str(slo))
+  block = st.poll()
+  assert block is not None
+  assert st.actuation_count == 2
+  assert "actuations [2 total]" in block
+  assert "autotune: tune_spec_k -1->2 (rule shed_burn)" in block
+  assert "autoscale: live_replicas 2->3 (rule shed_burn)" in block
+  # Actuations are not breach streams: the SLO panel stays clean.
+  assert st.slo_breaches == 0 and st.slo_state == {}
+
+
+def test_breach_pressure_freshness_is_per_stream():
+  """ISSUE 13 hardening: BreachPressure judges liveness per stream —
+  one stream RECOVERING shrinks the breached set without a single new
+  record on the wedged survivors, and must not read as fresh pressure
+  (an aggregate-sum check would misfire exactly as the system
+  recovers)."""
+  class FakeMon:
+    def __init__(self):
+      self.streams = {}
+
+    def breached_stream_obs(self):
+      return dict(self.streams)
+
+  mon = FakeMon()
+  probe = slo_lib.BreachPressure(mon, lambda rule, key: True)
+  assert probe.poll() == (False, False)
+  mon.streams = {("b", "x"): 3, ("b", "y"): 5}
+  assert probe.poll() == (True, True)          # new breached streams
+  assert probe.poll() == (True, False)         # nothing grew
+  mon.streams = {("b", "x"): 3}                # y recovered: sum shrank
+  assert probe.poll() == (True, False), \
+      "a recovery must not read as fresh pressure"
+  mon.streams = {("b", "x"): 4}
+  assert probe.poll() == (True, True)          # x's records flowed
+  mon.streams = {}
+  assert probe.poll() == (False, False)
+  mon.streams = {("b", "z"): 1}                # fresh breached stream
+  assert probe.poll() == (True, True)
+  assert slo_lib.BreachPressure(None, lambda r, k: True).poll() == \
+      (False, False)
+
+
 def test_monitor_skips_device_arrays_and_idle_burn_windows():
   """Raw registry pass-through can carry device arrays; evaluating one
   would force the host sync the sinks defer — they must be skipped, not

@@ -382,6 +382,51 @@ def test_collective_bytes_counter():
   assert collective_bytes(lambda v: v @ v.T, x) == 0.0
 
 
+def test_planner_measured_collective_bytes_override_analytic():
+  """ISSUE 13 satellite (ROADMAP item 5c): a profiler-measured
+  collective-bytes/step figure replaces the analytically-derived wire
+  bytes, so the crossover flips from evidence instead of modeled dims
+  — and the analytic model stays the fallback when no measurement is
+  passed."""
+  dims = dict(m=4096, k=8192, n_out=8192, axis_size=8, dtype_bytes=2)
+  analytic = plan_collective_matmul("all_gather_matmul", **dims)
+  assert analytic.enabled                    # comm-heavy: decomposes
+  # Measurement says the site moves almost NOTHING on the wire (e.g.
+  # XLA fused most of the gather away): nothing to hide, so per-step
+  # latency dominates and the measured decision is FUSED.
+  measured = plan_collective_matmul(
+      "all_gather_matmul", **dims, measured_collective_bytes=64.0)
+  assert not measured.enabled and measured.num_chunks == 1
+  assert measured.comm_us < analytic.comm_us
+  assert measured.comm_us == pytest.approx(64.0 / 100e9 * 1e6)
+  # The opposite flip: a site the analytic model keeps fused because
+  # its modeled bytes are tiny next to the matmul, but the profiler
+  # measured heavy real traffic — the evidence turns overlap on.
+  small = dict(m=16, k=8192, n_out=8192, axis_size=8, dtype_bytes=2)
+  assert not plan_collective_matmul(
+      "all_gather_matmul", **small).enabled
+  heavy = plan_collective_matmul(
+      "all_gather_matmul", **small,
+      measured_collective_bytes=8e6)
+  assert heavy.enabled and heavy.num_chunks >= 2
+  # None / 0 mean "no measurement": byte-identical analytic fallback.
+  assert plan_collective_matmul(
+      "all_gather_matmul", **dims,
+      measured_collective_bytes=None) == analytic
+  assert plan_collective_matmul(
+      "all_gather_matmul", **dims,
+      measured_collective_bytes=0.0) == analytic
+  # And the policy entry point threads the measurement through.
+  cfg_auto = epl.Config({})
+  assert overlap.resolve_num_chunks(
+      "all_gather_matmul", 8, m=16, k=8192, n_out=8192,
+      dtype=jnp.bfloat16, config=cfg_auto) == 1
+  assert overlap.resolve_num_chunks(
+      "all_gather_matmul", 8, m=16, k=8192, n_out=8192,
+      dtype=jnp.bfloat16, config=cfg_auto,
+      measured_collective_bytes=8e6) >= 2
+
+
 def test_planner_from_cost_model_path():
   """The profiled-cost twin: flops measured by XLA's cost analysis feed
   the same crossover model and produce a consistent verdict."""

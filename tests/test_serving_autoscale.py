@@ -1,0 +1,729 @@
+"""Self-healing fleet (ISSUE 13): SLO-driven actuators — the engine
+autotuner (serving/autotune.py) and the fleet autoscaler
+(serving/autoscale.py) — closing the sense->act control loop.
+
+The acceptance contract (`make chaos-heal`): under an injected 3x
+overload burst on a 2-replica process-transport fleet, the autoscaler
+spawns a third replica (a REAL subprocess), the autotuner tightens
+budgets, SLO burn recovers without operator input, every non-shed
+request's output is bit-exact vs the fault-free oracle, all replica
+compile counts stay 1, and after recovery the fleet drains back to 2
+live replicas.  The quick-marked fault-free-equivalence test pins the
+other half: actuators enabled with no breaches is bit-identical to the
+baseline stream with zero actuations and zero added recompiles.  The
+policy units (ladder, matching, cooldowns, flap breaker) drive pure
+host objects with fake clocks.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.observability import slo as slo_lib
+from easyparallellibrary_tpu.observability import trace as trace_lib
+from easyparallellibrary_tpu.observability.registry import MetricRegistry
+from easyparallellibrary_tpu.observability.slo import (
+    BurnRateRule, SLOMonitor, SLORule)
+from easyparallellibrary_tpu.serving import Request, Router
+from easyparallellibrary_tpu.serving.autoscale import FleetAutoscaler
+from easyparallellibrary_tpu.serving.autotune import (
+    TUNE_LEVELS, EngineAutotuner)
+from easyparallellibrary_tpu.serving.resilience import AdmissionController
+from easyparallellibrary_tpu.serving.scheduler import FCFSScheduler
+from easyparallellibrary_tpu.testing.chaos import overload_burst
+from easyparallellibrary_tpu.testing.factories import tiny_gpt
+
+FACTORY = "easyparallellibrary_tpu.testing.factories:tiny_gpt"
+
+
+@pytest.fixture(autouse=True)
+def _drop_ambient_observability():
+  yield
+  trace_lib.reset()
+  slo_lib.reset()
+
+
+def _prompts(n, lengths=(5, 3, 7, 2), vocab=64, seed=0):
+  r = np.random.RandomState(seed)
+  return [r.randint(0, vocab, (lengths[i % len(lengths)],)).astype(
+      np.int32) for i in range(n)]
+
+
+def _oracle(model, params, prompt, max_new):
+  import jax.numpy as jnp
+  from easyparallellibrary_tpu.models.gpt import generate
+  return np.asarray(
+      generate(model, params, jnp.asarray(prompt)[None], max_new))[0]
+
+
+# ------------------------------------------------------- config & trace
+
+
+def test_autotune_autoscale_config_validation():
+  with pytest.raises(ValueError, match="hold_steps"):
+    epl.Config({"serving": {"autotune": {"hold_steps": 0}}})
+  with pytest.raises(ValueError, match="max_level"):
+    epl.Config({"serving": {"autotune": {"max_level": 9}}})
+  with pytest.raises(ValueError, match="budget_chunks"):
+    epl.Config({"serving": {"autotune": {"budget_chunks": 0}}})
+  with pytest.raises(ValueError, match="min_replicas"):
+    epl.Config({"serving": {"autoscale": {"min_replicas": 3,
+                                          "max_replicas": 2}}})
+  with pytest.raises(ValueError, match="scale_up_cooldown_s"):
+    epl.Config({"serving": {"autoscale": {"scale_up_cooldown_s": -1.0}}})
+  conf = epl.Config({"serving": {"autoscale": {"rules": "ttft_p99"}}})
+  assert conf.serving.autoscale.rules == ("ttft_p99",)
+
+
+def test_overload_burst_trace_shape():
+  arr = overload_burst(10.0, 8, 4, factor=3.0, seed=0)
+  assert arr.shape == (12,)
+  assert np.all(np.diff(arr) >= 0) and arr[0] == 0.0
+  # The burst segment arrives ~factor x faster than the recovery tail.
+  burst_rate = 7 / max(arr[7] - arr[0], 1e-9)
+  tail_rate = 4 / max(arr[11] - arr[7], 1e-9)
+  assert burst_rate > tail_rate
+  with pytest.raises(ValueError, match="factor"):
+    overload_burst(10.0, 4, 2, factor=1.0)
+
+
+# -------------------------------------------------- autotuner (policy)
+
+
+class _FakeEngine:
+  """Duck-typed engine for pure ladder-policy tests: a REAL scheduler
+  and admission controller behind the attributes the tuner reads."""
+
+  def __init__(self, spec_k=4, num_slots=4, queue_limit=8):
+    self.scheduler = FCFSScheduler(num_slots=num_slots, prefill_chunk=8,
+                                   max_seq_len=32, spec_k=spec_k)
+    self.chunk = 8
+    self._admission = AdmissionController(queue_limit=queue_limit)
+    self._twin_label = "serving/fused_step"
+    self._track_prefix = "serving"
+
+
+def _burn_monitor(**kw):
+  kw.setdefault("objective", 0.5)
+  kw.setdefault("fast_window", 2)
+  kw.setdefault("slow_window", 3)
+  kw.setdefault("fast_burn", 1.0)
+  kw.setdefault("slow_burn", 1.0)
+  return SLOMonitor([BurnRateRule("shed_burn", bad="shed",
+                                  good="finished_requests", **kw)])
+
+
+class _BurnFeed:
+  """Monotone cumulative shed/finished counters fed to a monitor — the
+  well-formed stream a real engine produces (counters never run
+  backwards, so burn deltas stay meaningful)."""
+
+  def __init__(self, mon):
+    self.mon = mon
+    self.i = 0
+    self.shed = 0.0
+    self.good = 1.0
+
+  def drive(self, records, shed_step=5.0, good_step=0.0):
+    for _ in range(records):
+      self.shed += shed_step
+      self.good += good_step
+      self.mon.observe(self.i, {
+          "serving/shed": self.shed,
+          "serving/finished_requests": self.good})
+      self.i += 1
+
+
+def test_autotuner_escalates_sustains_and_recovers():
+  cfg = epl.Config({"serving": {"autotune": {"enabled": True,
+                                             "hold_steps": 5}}})
+  mon = _burn_monitor()
+  eng = _FakeEngine()
+  tuner = EngineAutotuner(eng, mon, config=cfg)
+  feed = _BurnFeed(mon)
+  # No breach -> level stays 0 and no knob moves.
+  tuner.on_step(0)
+  assert tuner.level == 0 and eng.scheduler.tune_spec_k == -1
+  feed.drive(5)
+  assert mon.breaches == 1 and tuner.breaches_heard == 1
+  tuner.on_step(0)
+  assert TUNE_LEVELS[tuner.level] == "spec_trim"
+  assert eng.scheduler.tune_spec_k == 2          # half of k=4
+  assert eng.scheduler.effective_spec_k == 2
+  # Sustained pressure (stream stays breached, no new event): one more
+  # level per hold window, through budget_tight up to slot_cap.
+  for s in range(1, 20):
+    tuner.on_step(s)
+  assert TUNE_LEVELS[tuner.level] == "slot_cap"
+  assert eng.scheduler.tune_spec_k == 0
+  assert eng.scheduler.tune_budget == eng.chunk
+  assert eng.scheduler.tune_slot_cap == 2        # half of 4, floor 1
+  assert eng.scheduler.effective_max_batch == 2
+  assert eng._admission.floor_level == 1
+  # The admission ladder cannot de-escalate below the pinned floor.
+  assert eng._admission.observe(0, 0.0) == 1
+  # Burn recovers -> staged release, one level per hold window, back
+  # to baseline with every clamp gone.
+  feed.drive(4, shed_step=0.0, good_step=10.0)
+  assert mon.breached_streams() == []
+  for s in range(20, 60):
+    tuner.on_step(s)
+  assert tuner.level == 0
+  assert eng.scheduler.tune_spec_k == -1
+  assert eng.scheduler.tune_budget == 0
+  assert eng.scheduler.tune_slot_cap == 0
+  assert eng._admission.floor_level == 0
+  assert tuner.actuations == 6                   # 3 up + 3 down
+  assert mon.actuations == 6                     # jsonl-stream parity
+
+
+def test_autotuner_live_sustained_breach_never_goes_stale():
+  """The stale escape must key off RECORDS stopping, not breach-event
+  age: a genuinely sustained overload (records flowing, stream stays
+  breached, no transition events) holds mitigation indefinitely —
+  releasing it mid-burn and never re-escalating would be the bug."""
+  cfg = epl.Config({"serving": {"autotune": {"enabled": True,
+                                             "hold_steps": 2,
+                                             "max_level": 1}}})
+  mon = _burn_monitor()
+  eng = _FakeEngine()
+  tuner = EngineAutotuner(eng, mon, config=cfg)
+  feed = _BurnFeed(mon)
+  feed.drive(5)
+  tuner.on_step(0)
+  assert tuner.level == 1
+  for s in range(1, 2 * tuner.stale_steps + 5):
+    feed.drive(1)            # overload continues: records keep flowing
+    tuner.on_step(s)
+  assert tuner.level == 1, \
+      "live sustained breach was released as stale mid-overload"
+  assert mon.breached_streams(), "the stream should still be breached"
+
+
+def test_autotuner_stale_breach_cannot_pin():
+  """A breach stream wedged 'breached' whose records stopped flowing
+  (idle engine: burn windows see no traffic, so the stream never emits
+  a recovery) goes stale and the tuner still climbs down."""
+  cfg = epl.Config({"serving": {"autotune": {"enabled": True,
+                                             "hold_steps": 2,
+                                             "max_level": 1}}})
+  mon = _burn_monitor()
+  eng = _FakeEngine()
+  tuner = EngineAutotuner(eng, mon, config=cfg)
+  _BurnFeed(mon).drive(5)
+  tuner.on_step(0)
+  assert tuner.level == 1
+  assert mon.breached_streams()                  # wedged breached
+  for s in range(1, tuner.stale_steps + 5):
+    tuner.on_step(s)
+  assert tuner.level == 0, "stale breach pinned the engine slow"
+
+
+def test_autotuner_spec_trim_floors_at_one_draft():
+  """spec_trim trims, it does not shut off: a k=1 drafter keeps its
+  one draft at level 1 (full spec-off is level 2's job); with no
+  drafter (k=0) the clamp stays a no-op."""
+  cfg = epl.Config({"serving": {"autotune": {"enabled": True}}})
+  tuner = EngineAutotuner(_FakeEngine(spec_k=1), None, config=cfg)
+  assert tuner._level_knobs(1)["tune_spec_k"] == 1
+  assert tuner._level_knobs(2)["tune_spec_k"] == 0
+  no_drafter = EngineAutotuner(_FakeEngine(spec_k=0), None, config=cfg)
+  assert no_drafter._level_knobs(1)["tune_spec_k"] == 0
+
+
+def test_autotuner_matching_scopes_breaches():
+  cfg = epl.Config({"serving": {"autotune": {"enabled": True}}})
+  eng = _FakeEngine()
+  eng._track_prefix = "serving/replica0"
+  eng._twin_label = "serving/replica0/fused_step"
+  tuner = EngineAutotuner(eng, None, config=cfg)
+  assert tuner._matches({"metric": "serving/replica0/ttft_p99_s"})
+  assert tuner._matches({"metric": "serving/itl_p99_s"})
+  assert tuner._matches({"twin": "serving/replica0/fused_step"})
+  assert not tuner._matches({"metric": "serving/replica1/ttft_p99_s"})
+  assert not tuner._matches({"metric": "serving/fleet/ttft_p99_s"})
+  assert not tuner._matches({"twin": "serving/replica1/fused_step"})
+  assert not tuner._matches({"metric": "train/loss"})
+  assert not tuner._matches({})
+  # A BARE engine (prefix "serving") must not swallow fleet- or
+  # replica-scoped streams — the fleet is the autoscaler's to act on,
+  # and a sibling replica's breach is not this engine's.
+  bare = EngineAutotuner(_FakeEngine(), None, config=cfg)
+  assert bare._matches({"metric": "serving/ttft_p99_s"})
+  assert not bare._matches({"metric": "serving/fleet/ttft_p99_s"})
+  assert not bare._matches({"metric": "serving/replica1/ttft_p99_s"})
+
+
+# ------------------------------------------------- autoscaler (policy)
+
+
+class FakeClock:
+  def __init__(self, t=0.0):
+    self.t = t
+
+  def __call__(self):
+    return self.t
+
+  def advance(self, dt):
+    self.t += dt
+
+
+class FakeReplica:
+  def __init__(self, index):
+    self.index = index
+    self.finished = {}
+    self.has_work = False
+    self.num_slots = 4
+    self.stats = None
+    self.watchdog_timeouts = 0
+    self.bad_steps = 0
+    self.itl_ewma_s = 0.0
+
+  load = property(lambda self: 0)
+  queue_depth = property(lambda self: 0)
+  num_active = property(lambda self: 0)
+
+  def submit(self, req):
+    return True
+
+  def cancel(self, uid):
+    return False
+
+  def step(self):
+    return []
+
+  def evacuate(self):
+    return []
+
+  def restore_request(self, snap, front=False):
+    return snap["request"]["uid"]
+
+  def close(self):
+    pass
+
+
+def _scaling_router(clock, monitor=None, **autoscale):
+  autoscale.setdefault("enabled", True)
+  autoscale.setdefault("min_replicas", 2)
+  autoscale.setdefault("max_replicas", 4)
+  autoscale.setdefault("scale_up_cooldown_s", 1.0)
+  autoscale.setdefault("scale_down_cooldown_s", 10.0)
+  autoscale.setdefault("flap_window_s", 30.0)
+  config = epl.Config({"serving": {"autoscale": autoscale}})
+  if monitor is not None:
+    slo_lib.install(monitor)   # explicit install wins; Router binds it
+  router = Router(replicas=[FakeReplica(0), FakeReplica(1)],
+                  config=config, clock=clock)
+  # Injected fleets carry no build recipe; grow with fakes instead.
+  def add_replica():
+    index = len(router.replicas)
+    router.replicas.append(FakeReplica(index))
+    router.health.append(router._make_health(index))
+    return index
+  router.add_replica = add_replica
+  return router, router._autoscaler
+
+
+def _burn_breach(scaler, rule="shed_burn"):
+  """Deliver one burn-rate breach exactly as the monitor would (the
+  listener path; end-to-end monitor wiring is covered by the quick and
+  slow episodes below)."""
+  scaler._on_breach(rule, {"metric": "serving/fleet/shed",
+                           "fast_burn": 4.0, "slow_burn": 2.0})
+
+
+def test_autoscaler_scales_up_on_burn_and_drains_after_quiet():
+  clock = FakeClock()
+  router, scaler = _scaling_router(clock)
+  router.step()
+  assert len(router.replicas) == 2 and scaler.scale_ups == 0
+  # A threshold rule NOT named in autoscale.rules is ignored.
+  scaler._on_breach("ttft_p99", {"metric": "serving/fleet/ttft_p99_s",
+                                 "value": 9.0, "target": 0.5})
+  router.step()
+  assert scaler.scale_ups == 0
+  _burn_breach(scaler)
+  router.step()                       # actuation lands at sweep start
+  assert scaler.scale_ups == 1 and len(router.replicas) == 3
+  assert scaler._added == [2]
+  assert router.states() == ["healthy", "healthy", "healthy"]
+  counters = router.router_counters()
+  assert counters["scale_ups"] == 1.0 and counters["scale_downs"] == 0.0
+  # A second burn inside the scale-up cooldown is held...
+  clock.advance(0.5)
+  _burn_breach(scaler)
+  router.step()
+  assert scaler.scale_ups == 1 and scaler.holds == 1
+  # ...past it, the fleet grows again, up to the max_replicas bound.
+  clock.advance(1.0)
+  _burn_breach(scaler)
+  router.step()
+  assert scaler.scale_ups == 2 and len(router.replicas) == 4
+  clock.advance(1.5)
+  _burn_breach(scaler)
+  router.step()
+  assert len(router.replicas) == 4 and scaler.holds == 2
+  # Budget recovered -> after the quiet cooldown the youngest-added
+  # replicas drain back out, one per sweep — but never capacity the
+  # autoscaler did not add.
+  clock.advance(100.0)
+  router.step()
+  assert scaler.scale_downs == 1
+  assert router.states()[3] == "draining"
+  clock.advance(100.0)
+  router.step()
+  assert scaler.scale_downs == 2
+  assert router.states() == ["healthy", "healthy", "draining",
+                             "draining"]
+  clock.advance(100.0)
+  router.step()                       # nothing added left: no shrink
+  assert scaler.scale_downs == 2
+  assert [h.state for h in router.health[:2]] == ["healthy", "healthy"]
+
+
+def test_autoscaler_named_threshold_rule_scales():
+  clock = FakeClock()
+  router, scaler = _scaling_router(clock, rules="ttft_p99")
+  scaler._on_breach("ttft_p99", {"metric": "serving/fleet/ttft_p99_s",
+                                 "value": 9.0, "target": 0.5})
+  router.step()
+  assert scaler.scale_ups == 1 and len(router.replicas) == 3
+
+
+def test_autoscaler_rejoins_only_its_own_drained_capacity():
+  """Warm rejoin targets only replicas the AUTOSCALER drained; an
+  operator-drained replica is maintenance in progress and is never
+  silently reverted by a breach — the fleet grows by cold spawn
+  instead."""
+  clock = FakeClock()
+  # min_replicas=1: the operator drain already takes live to 2, and
+  # phase two needs headroom for the autoscaler's own shrink.
+  router, scaler = _scaling_router(clock, min_replicas=1,
+                                   scale_down_cooldown_s=5.0)
+  router.drain(1)                     # OPERATOR maintenance drain
+  assert router.states() == ["healthy", "draining"]
+  _burn_breach(scaler)
+  router.step()
+  assert scaler.scale_ups == 1
+  assert len(router.replicas) == 3, "operator drain must not revert"
+  assert router.states() == ["healthy", "draining", "healthy"]
+  # The autoscaler's OWN drained capacity IS the warm-rejoin target.
+  clock.advance(50.0)
+  router.step()                       # quiet -> drains its replica 2
+  assert scaler.scale_downs == 1 and scaler._parked == [2]
+  clock.advance(2.0)
+  _burn_breach(scaler)
+  router.step()
+  assert scaler.scale_ups == 2
+  assert len(router.replicas) == 3, "warm rejoin, not another spawn"
+  assert router.states() == ["healthy", "draining", "healthy"]
+  assert scaler._parked == [] and 2 in scaler._added
+  # A parked claim dies the moment the replica leaves draining through
+  # a NON-autoscaler path: operator rejoins 2, later drains it for
+  # maintenance — a breach must now spawn, never revert that drain.
+  clock.advance(50.0)
+  router.step()                       # quiet -> autoscaler parks 2
+  assert scaler._parked == [2]
+  router.rejoin(2)                    # operator takes it back...
+  router.step()                       # ...claim pruned this sweep
+  assert scaler._parked == []
+  router.drain(2)                     # operator maintenance drain
+  clock.advance(2.0)
+  _burn_breach(scaler)
+  router.step()
+  assert len(router.replicas) == 4, "operator drain was reverted"
+  assert router.health[2].state == "draining"
+
+
+def test_autoscaler_never_drains_operator_base_capacity():
+  """Shrink touches ONLY capacity the autoscaler added: if its spawned
+  replica has since died, the operator's base fleet is not a fallback
+  drain target."""
+  clock = FakeClock()
+  router, scaler = _scaling_router(clock)
+  _burn_breach(scaler)
+  router.step()
+  assert scaler._added == [2]
+  router.health[2].mark_down("chaos: added capacity died")
+  clock.advance(100.0)
+  router.step()
+  assert scaler.scale_downs == 0
+  assert [h.state for h in router.health[:2]] == ["healthy", "healthy"]
+
+
+def test_autoscaler_live_burn_sustains_growth_and_blocks_shrink():
+  """A burn that records keep confirming (stream breached, counts
+  growing) sustains growth past the first cooldown AND holds the quiet
+  window open indefinitely — only once its records STOP flowing does
+  the stale escape let the fleet shrink."""
+  clock = FakeClock()
+  monitor = _burn_monitor()
+  router, scaler = _scaling_router(clock, monitor=monitor)
+  assert router._slo is monitor
+  feed_i = [0]
+
+  def burn(shed):
+    monitor.observe(feed_i[0], {
+        "serving/custom/shed": float(shed),
+        "serving/custom/finished_requests": 1.0})
+    feed_i[0] += 1
+
+  total = [0.0]
+  for _ in range(5):
+    total[0] += 5.0
+    burn(total[0])
+  assert monitor.breaches == 1
+  router.step()
+  assert scaler.scale_ups == 1 and len(router.replicas) == 3
+  # Records keep flowing: growth continues after the hold-out...
+  clock.advance(1.2)
+  total[0] += 5.0
+  burn(total[0])
+  router.step()
+  assert scaler.scale_ups == 2 and len(router.replicas) == 4
+  # ...and the shrink stays blocked FAR past the quiet cooldown.
+  for _ in range(12):
+    clock.advance(3.0)
+    total[0] += 5.0
+    burn(total[0])
+    router.step()
+  assert scaler.scale_downs == 0, "live burn was read as recovered"
+  # Records stop (stream wedges breached): the stale escape opens the
+  # quiet window and the added capacity drains back out.
+  clock.advance(100.0)
+  router.step()
+  assert scaler.scale_downs == 1
+  assert router.states()[3] == "draining"
+
+
+def test_autoscaler_flap_breaker_doubles_holdout():
+  clock = FakeClock()
+  router, scaler = _scaling_router(
+      clock, scale_down_cooldown_s=5.0, flap_window_s=30.0)
+  base = scaler.scale_up_cooldown_s
+  _burn_breach(scaler)
+  router.step()
+  assert scaler.scale_ups == 1 and scaler.flap_trips == 0
+  # Quiet -> drain -> breach again INSIDE the flap window: the re-grow
+  # counts a trip and the next hold-out doubles.
+  clock.advance(6.0)
+  router.step()
+  assert scaler.scale_downs == 1
+  clock.advance(2.0)
+  _burn_breach(scaler)
+  router.step()
+  assert scaler.scale_ups == 2 and scaler.flap_trips == 1
+  assert scaler.scale_up_holdout_s() == pytest.approx(2 * base)
+  # A breach inside the DOUBLED hold-out is held, not acted on.
+  clock.advance(1.2)
+  _burn_breach(scaler)
+  router.step()
+  assert scaler.scale_ups == 2 and scaler.holds >= 1
+  # A clean flap window decays the trip again.
+  clock.advance(31.0)
+  router.step()
+  assert scaler.flap_trips == 0
+
+
+# --------------------------------------- quick: fault-free equivalence
+
+
+@pytest.mark.quick
+def test_actuators_fault_free_bit_exact_zero_actuations():
+  """The fault-free guard (ISSUE 13 satellite): autotuner + autoscaler
+  + SLO monitor enabled with NO breaches is bit-identical to the
+  baseline fleet stream — zero actuations fire, every engine's fused
+  step compiles once, and the monitor stays silent."""
+  prompts = _prompts(4)
+  max_new = (6, 7, 4, 5)
+
+  def drive(router):
+    out = {}
+    for i in range(2):
+      assert router.submit(Request(uid=i, prompt=prompts[i],
+                                   max_new_tokens=max_new[i]))
+    for _ in range(2):
+      for fin in router.step():
+        out[fin.uid] = fin.tokens
+    for i in range(2, 4):
+      assert router.submit(Request(uid=i, prompt=prompts[i],
+                                   max_new_tokens=max_new[i]))
+    out.update(router.run())
+    return out
+
+  epl.init()
+  model, params = tiny_gpt()
+  base_router = Router(model, params, num_replicas=2, num_slots=2,
+                       prefill_chunk=4, registry=MetricRegistry())
+  base = drive(base_router)
+  base_router.close()
+  slo_lib.reset()
+
+  config = epl.Config({
+      "serving": {
+          "resilience": {"enabled": True, "queue_limit": 16},
+          "autotune": {"enabled": True, "hold_steps": 2},
+          "autoscale": {"enabled": True, "min_replicas": 2,
+                        "max_replicas": 4,
+                        "scale_up_cooldown_s": 0.0,
+                        "scale_down_cooldown_s": 0.5},
+      },
+      "observability": {"slo": {
+          "enabled": True, "ttft_p99_s": 100.0, "itl_p99_s": 100.0,
+          "shed_objective": 0.5, "fast_window": 2, "slow_window": 3,
+          "fast_burn": 1.0, "slow_burn": 1.0}},
+  })
+  epl.init(config)
+  router = Router(model, params, num_replicas=2, config=config,
+                  num_slots=2, prefill_chunk=4,
+                  registry=MetricRegistry())
+  healed = drive(router)
+  monitor = slo_lib.get_monitor()
+  assert monitor is not None and monitor.breaches == 0
+  assert monitor.actuations == 0
+  assert router._autoscaler is not None
+  assert router._autoscaler.counters() == {
+      "scale_ups": 0.0, "scale_downs": 0.0, "autoscale_holds": 0.0,
+      "flap_trips": 0.0}
+  assert len(router.replicas) == 2
+  for rep in router.replicas:
+    tuner = rep.engine._autotuner
+    assert tuner is not None and tuner.actuations == 0
+    assert tuner.level == 0
+    assert rep.engine._step_fn._cache_size() == 1
+    assert rep.engine._compile_sentinel.recompiles == 0
+  assert sorted(base) == sorted(healed)
+  for uid in base:
+    np.testing.assert_array_equal(healed[uid], base[uid],
+                                  err_msg=f"req {uid}")
+  # The per-step serving records carry the actuator evidence keys.
+  latest = router.replicas[0].engine.registry.latest()
+  assert latest["serving/replica0/autotune_level"] == 0
+  assert latest["serving/replica0/autotune_actuations"] == 0
+  router.close()
+
+
+# ------------------------------------ slow: the chaos-heal acceptance
+
+
+@pytest.mark.slow
+def test_overload_burst_heals_scales_and_drains_back(tmp_path):
+  """`make chaos-heal` acceptance (ISSUE 13): a 3x overload burst on a
+  2-replica PROCESS-transport fleet — the autoscaler spawns a third
+  replica (real subprocess), at least one engine autotuner tightens
+  its knobs, the burn recovers with no operator input, every non-shed
+  request is bit-exact vs the fault-free oracle, all replica compile
+  counts stay 1, and after recovery the fleet drains back to 2 live
+  replicas."""
+  events_path = str(tmp_path / "slo_events.jsonl")
+  config = epl.Config({
+      "serving": {
+          "resilience": {"enabled": True, "queue_limit": 3},
+          "router": {"transport": "process", "heartbeat_s": 0.02},
+          "autotune": {"enabled": True, "hold_steps": 8},
+          "autoscale": {"enabled": True, "min_replicas": 2,
+                        "max_replicas": 3,
+                        "scale_up_cooldown_s": 0.2,
+                        "scale_down_cooldown_s": 1.5,
+                        "flap_window_s": 10.0},
+      },
+      "observability": {"slo": {
+          "enabled": True, "events_path": events_path,
+          "shed_objective": 0.5, "fast_window": 2, "slow_window": 4,
+          "fast_burn": 1.0, "slow_burn": 1.0}},
+  })
+  epl.init(config)
+  model, params = tiny_gpt()          # the parent-side oracle twin
+  router = Router(num_replicas=2, config=config, factory=FACTORY,
+                  num_slots=2, prefill_chunk=4)
+  prompts = _prompts(20, seed=3)
+  max_new = 6
+  accepted, shed = [], []
+  # 3x overload burst, waves interleaved with sweeps so the shed
+  # counter GROWS across successive fleet rollups (a burn window needs
+  # deltas, not one spike before the first record).
+  uid = 0
+  for _wave in range(5):
+    for _ in range(4):
+      if router.submit(Request(uid=uid, prompt=prompts[uid],
+                               max_new_tokens=max_new)):
+        accepted.append(uid)
+      else:
+        shed.append(uid)
+      uid += 1
+    for _ in range(3):
+      router.step()
+      time.sleep(0.02)               # let heartbeat rollups publish
+  assert shed, "the burst must overload admission (nothing shed?)"
+  # Serve the backlog; the breach + scale-up land mid-drive.  The
+  # moment the third replica exists, a post-wave goes through it (its
+  # load gauge is zero while the survivors still hold the backlog, so
+  # least-loaded dispatch picks it) — the added capacity must SERVE,
+  # not idle.
+  post, post_placed = [], []
+  post_prompts = _prompts(6, seed=11)   # fresh: no prefix affinity,
+  deadline = time.monotonic() + 120.0   # so least-loaded wins and the
+  scaler = router._autoscaler           # idle new replica is chosen
+  while router.has_work and time.monotonic() < deadline:
+    router.step()
+    if scaler.scale_ups >= 1 and not post and router.has_work:
+      for k in range(6):
+        uid = 100 + k
+        if router.submit(Request(uid=uid, prompt=post_prompts[k],
+                                 max_new_tokens=max_new)):
+          post.append(uid)
+          post_placed.append(router.placement.get(uid))
+  assert scaler.scale_ups >= 1, "no scale-up fired"
+  assert len(router.replicas) == 3
+  spawned = router.replicas[2]
+  assert spawned.child_pid is not None and spawned.last_spawn_s > 0
+  assert 2 in post_placed, "the spawned replica never received work"
+  # Recovery tail: light traffic keeps rollups flowing with zero new
+  # sheds, so the burn recovers and the quiet cooldown elapses.
+  monitor = slo_lib.get_monitor()
+  tail_uid = 1000
+  deadline = time.monotonic() + 60.0
+  while time.monotonic() < deadline:
+    if not router.has_work:
+      if scaler.scale_downs >= 1:
+        break
+      router.submit(Request(uid=tail_uid, prompt=prompts[0],
+                            max_new_tokens=2))
+      tail_uid += 1
+    router.step()
+    time.sleep(0.02)
+  assert monitor.recoveries >= 1, "burn never recovered"
+  assert scaler.scale_downs >= 1, "fleet never drained back down"
+  live = [h.state for h in router.health
+          if h.state in ("healthy", "suspect")]
+  assert len(live) == 2
+  assert router.health[2].state == "draining"
+  # Compile-once fleet-wide: every child's beat-carried cache size is 1.
+  for rep in router.replicas:
+    assert rep.compile_count == 1, "actuation cost a recompile"
+  # Bit-exactness for every non-shed request vs the oracle — the burst
+  # wave AND the post-scale-up wave the spawned replica served.
+  for u in accepted + post:
+    fin = router.finished[u]
+    if fin.finish_reason == "shed":  # replica-side admission shed
+      continue
+    assert fin.finish_reason == "length"
+    prompt = prompts[u] if u < 100 else post_prompts[u - 100]
+    np.testing.assert_array_equal(
+        fin.tokens, _oracle(model, params, prompt, max_new),
+        err_msg=f"req {u}")
+  router.close()
+  # The events stream recorded the loop closing: autoscale actuations
+  # from the parent, autotune actuations from at least one child.
+  events = [json.loads(line) for line in open(events_path)]
+  actuations = [e for e in events if e["event"] == "actuation"]
+  assert any(e.get("actuator") == "autoscale" and
+             e.get("action") == "scale_up" for e in actuations)
+  assert any(e.get("actuator") == "autotune" for e in actuations), \
+      "no child autotuner actuation reached slo_events.jsonl"
+  assert any(e["event"] == "breach" for e in events)
+  assert any(e["event"] == "recover" for e in events)
