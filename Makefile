@@ -16,6 +16,20 @@ test: build
 lint:
 	python -m easyparallellibrary_tpu.analysis
 
+# Perf regression gate: device cost-card invariants (compile count,
+# flops/token, KV bytes/request, peak-HBM bound, donation-verified —
+# collected live from the canonical tiny twins) and selected
+# BENCH_EVIDENCE.json structural metrics, pinned with tolerances in
+# perf_budget.json (observability/perfgate.py; docs/observability.md
+# "Device truth").  Malformed evidence records are REFUSED, not
+# skipped.  Regenerate the budget only for an intentional perf change:
+# python -m easyparallellibrary_tpu.observability.perfgate --write-budget
+perf-gate:
+	python -m easyparallellibrary_tpu.observability.perfgate
+
+# The full static + perf gate chain: epl-lint, then the perf budget.
+gate: lint perf-gate
+
 bench:
 	python bench.py
 
@@ -122,6 +136,8 @@ help:
 	@echo "  build          - build the native IO extension (csrc/)"
 	@echo "  test           - full pytest suite (stops on first failure)"
 	@echo "  lint           - epl-lint static invariant checker (zero findings gate)"
+	@echo "  perf-gate      - perf budget gate: cost cards + bench evidence (perf_budget.json)"
+	@echo "  gate           - lint + perf-gate"
 	@echo "  bench          - official perf capture (bench.py)"
 	@echo "  chaos          - training fault-injection suite"
 	@echo "  chaos-serve    - serving resilience chaos (NaN/hang/overload)"
@@ -142,4 +158,4 @@ help:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test lint bench chaos chaos-serve chaos-router chaos-proc chaos-heal serve-bench paged-bench spec-bench overload-bench router-bench heal-bench trace-demo obs-bench help clean
+.PHONY: all build test lint perf-gate gate bench chaos chaos-serve chaos-router chaos-proc chaos-heal serve-bench paged-bench spec-bench overload-bench router-bench heal-bench trace-demo obs-bench help clean

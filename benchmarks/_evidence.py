@@ -1,0 +1,38 @@
+"""Shared BENCH_EVIDENCE.json door for every benchmark script.
+
+The schema enforcement itself lives in the store —
+``utils.bench_evidence.append_record`` validates every record (name =
+``metric`` / ts = ``unix_time``/``utc`` / context = ``config`` +
+backend tags / metrics = a numeric ``value`` and/or payload keys)
+before writing, so EVERY writer — these benchmarks, ``bench.py``'s
+direct call — fails loudly at write time rather than months later at
+``make perf-gate`` (which refuses malformed records,
+observability/perfgate.py).  This module is just the benchmarks' common
+import of that door (benchmark files run as scripts, so their own
+directory is ``sys.path[0]``).
+
+Usage, from any benchmark::
+
+    import _evidence
+    _evidence.append_record({
+        "metric": "decode_throughput",          # name
+        "config": {...},                        # context
+        "useful_tokens_per_s": 123.4,           # metrics payload
+    })
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from easyparallellibrary_tpu.utils import bench_evidence  # noqa: E402
+
+append_record = bench_evidence.append_record
+evidence_path = bench_evidence.evidence_path
+load_records = bench_evidence.load_records
+latest_record = bench_evidence.latest_record
+validate_record = bench_evidence.validate_record

@@ -16,7 +16,7 @@ Both run on a virtual clock advanced by MEASURED device/step wall time
 (arrival gaps don't count against either server), so the comparison is
 pure service efficiency: useful tokens/s, per-request completion-latency
 p50/p99, time-to-first-token, and slot occupancy.  The record lands in
-``BENCH_EVIDENCE.json`` via ``utils.bench_evidence`` and is printed as
+``BENCH_EVIDENCE.json`` via the validated ``_evidence`` writer and is printed as
 one JSON line.
 
 CPU-mesh numbers attest the structural win (horizon waste removed,
@@ -57,7 +57,7 @@ from easyparallellibrary_tpu.profiler.serving import (  # noqa: E402
 from easyparallellibrary_tpu.serving import (  # noqa: E402
     ContinuousBatchingEngine, Request)
 from easyparallellibrary_tpu.testing.chaos import poisson_trace  # noqa: E402
-from easyparallellibrary_tpu.utils import bench_evidence  # noqa: E402
+import _evidence  # noqa: E402  (the validated shared writer)
 
 METRIC = "decode_throughput"
 PAGED_METRIC = "paged_decode"
@@ -398,7 +398,7 @@ def run_paged(num_requests: int = 12, arrival_rate_hz: float = 4.0,
                    "at num_slots * chunk."),
       },
   }
-  bench_evidence.append_record(record)
+  _evidence.append_record(record)
   print(json.dumps(record))
   return record
 
@@ -435,7 +435,7 @@ def run(num_requests: int = 32, arrival_rate_hz: float = 40.0,
       "speedup_tokens_per_s":
           continuous["tokens_per_s"] / static["tokens_per_s"],
   }
-  bench_evidence.append_record(record)
+  _evidence.append_record(record)
   print(json.dumps(record))
   return record
 

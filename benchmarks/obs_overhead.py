@@ -56,7 +56,7 @@ from easyparallellibrary_tpu.profiler.serving import (  # noqa: E402
     ServingStats)
 from easyparallellibrary_tpu.serving import (  # noqa: E402
     ContinuousBatchingEngine, Request)
-from easyparallellibrary_tpu.utils import bench_evidence  # noqa: E402
+import _evidence  # noqa: E402  (the validated shared writer)
 
 METRIC = "observability_overhead"
 
@@ -158,7 +158,7 @@ def run(episodes_per_side: int = 8, num_slots: int = 4, chunk: int = 8,
       "slo_rules": [rule.name for rule in monitor.rules],
       "traced_events": tracer._n_appended,
   }
-  bench_evidence.append_record(record)
+  _evidence.append_record(record)
   print(json.dumps(record, indent=2))
   if not record["within_5pct"]:
     print("WARNING: overhead above the 5% budget on BOTH estimators — "

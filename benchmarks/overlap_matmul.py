@@ -10,7 +10,7 @@ TPU slice when one is attached):
 for ring chunk counts K in {1, 2, 4, 8} (K=1 IS the fused program), and
 records the sweep — times plus the planner's analytic crossover verdict
 for the same shapes — into the BENCH evidence machinery
-(``BENCH_EVIDENCE.json`` via ``utils.bench_evidence``), printing the
+(``BENCH_EVIDENCE.json`` via the validated ``_evidence`` writer), printing the
 record as one JSON line.
 
 CPU-mesh numbers attest program structure (the ring lowers, stays exact,
@@ -51,7 +51,7 @@ from benchmarks._common import force, null_round_trip  # noqa: E402
 from easyparallellibrary_tpu.communicators import overlap  # noqa: E402
 from easyparallellibrary_tpu.parallel.planner import (  # noqa: E402
     plan_collective_matmul)
-from easyparallellibrary_tpu.utils import bench_evidence  # noqa: E402
+import _evidence  # noqa: E402  (the validated shared writer)
 from easyparallellibrary_tpu.utils.compat import shard_map  # noqa: E402
 
 METRIC = "overlap_collective_matmul"
@@ -137,7 +137,7 @@ def run(m_per_dev: int = 128, k: int = 512, n_out: int = 512,
               for kind, p in plans.items()},
       },
   }
-  bench_evidence.append_record(record)
+  _evidence.append_record(record)
   print(json.dumps(record), flush=True)
   return record
 

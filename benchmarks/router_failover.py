@@ -13,7 +13,7 @@ Serves one seeded Poisson trace through the multi-replica Router
     queued + in-flight requests, and resumes them on the survivor via
     prefix replay.
 
-The record (``BENCH_EVIDENCE.json`` via ``utils.bench_evidence``)
+The record (``BENCH_EVIDENCE.json`` via the validated ``_evidence`` writer)
 carries per-episode tokens/s, TTFT p50/p99 and makespan, the kill
 episode's failover/migration counts, and the two acceptance headlines:
 ``lost_requests`` (must be 0 — every request submitted to the kill
@@ -189,8 +189,8 @@ def run(num_requests: int = 32, num_slots: int = 4, chunk: int = 4,
       "tokens_per_s_scaling": fleet["tokens_per_s"]
           / max(single["tokens_per_s"], 1e-9),
   }
-  from easyparallellibrary_tpu.utils import bench_evidence
-  bench_evidence.append_record(record)
+  import _evidence  # the validated shared writer
+  _evidence.append_record(record)
   print(json.dumps(record))
   assert lost == 0, f"{lost} request(s) lost in the kill episode"
   assert exact, "failover streams diverged from the fault-free baseline"
@@ -360,8 +360,8 @@ def run_process(num_requests: int = 32, num_slots: int = 4,
       "orphans_after": (single["orphans_after"] + fleet["orphans_after"]
                         + kill["orphans_after"]),
   }
-  from easyparallellibrary_tpu.utils import bench_evidence
-  bench_evidence.append_record(record)
+  import _evidence  # the validated shared writer
+  _evidence.append_record(record)
   print(json.dumps(record))
   assert lost == 0, f"{lost} request(s) lost in the SIGKILL episode"
   assert exact, "SIGKILL failover streams diverged from fault-free"

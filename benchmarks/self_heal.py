@@ -13,7 +13,7 @@ is served twice by a 2-replica in-process fleet:
     (serving/autoscale.py) live: burn breaches tighten per-engine knobs
     and grow the live replica set, the recovery tail releases both.
 
-The record (``BENCH_EVIDENCE.json`` via ``utils.bench_evidence``)
+The record (``BENCH_EVIDENCE.json`` via the validated ``_evidence`` writer)
 carries both sides' shed fraction, served-request TTFT p50/p99 (virtual
 clock — arrivals and latencies advance by MEASURED step wall time, the
 decode_throughput.py recipe), and the healing side's actuation
@@ -215,8 +215,8 @@ def run(num_requests: int = 48, overload_factor: float = 3.0,
       "shed_frac_ratio":
           frozen["shed_frac"] / max(healing["shed_frac"], 1e-9),
   }
-  from easyparallellibrary_tpu.utils import bench_evidence
-  bench_evidence.append_record(record)
+  import _evidence  # the validated shared writer
+  _evidence.append_record(record)
   print(json.dumps(record))
   return record
 

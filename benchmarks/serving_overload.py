@@ -14,7 +14,7 @@ step wall time, exactly like benchmarks/decode_throughput.py):
     degrade under pressure, and every ACCEPTED request keeps a bounded
     queue wait.
 
-The record (``BENCH_EVIDENCE.json`` via ``utils.bench_evidence``)
+The record (``BENCH_EVIDENCE.json`` via the validated ``_evidence`` writer)
 carries both sides' TTFT p50/p99 and queue peaks, the resilient side's
 shed fraction and ladder transitions, and the headline
 ``ttft_p99_ratio`` (unprotected / resilient — how much first-token
@@ -164,8 +164,8 @@ def run(num_requests: int = 48, overload_factor: float = 3.0,
       "ttft_p99_ratio":
           unprotected["ttft_p99_s"] / max(resilient["ttft_p99_s"], 1e-9),
   }
-  from easyparallellibrary_tpu.utils import bench_evidence
-  bench_evidence.append_record(record)
+  import _evidence  # the validated shared writer
+  _evidence.append_record(record)
   print(json.dumps(record))
   return record
 

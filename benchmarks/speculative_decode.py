@@ -17,7 +17,7 @@ win is purely accepted-tokens-per-step: every accepted draft is a
 committed token the plain engine would have spent a whole step on.
 Records useful tokens/s (both engines), accepted-tokens-per-step and
 acceptance rate into ``BENCH_EVIDENCE.json`` via
-``utils.bench_evidence`` and prints the record as one JSON line.
+the validated ``_evidence`` writer and prints the record as one JSON line.
 
 Run: ``python benchmarks/speculative_decode.py`` (or ``make spec-bench``).
 """
@@ -51,7 +51,7 @@ from easyparallellibrary_tpu.models.gpt import generate  # noqa: E402
 from easyparallellibrary_tpu.profiler.serving import ServingStats  # noqa: E402
 from easyparallellibrary_tpu.serving import (  # noqa: E402
     ContinuousBatchingEngine, NgramDrafter, Request)
-from easyparallellibrary_tpu.utils import bench_evidence  # noqa: E402
+import _evidence  # noqa: E402  (the validated shared writer)
 
 METRIC = "speculative_decode"
 
@@ -151,7 +151,7 @@ def run(num_requests: int = 16, seed_len: int = 8, roll: int = 24,
       },
       "traces": traces,
   }
-  bench_evidence.append_record(record)
+  _evidence.append_record(record)
   print(json.dumps(record))
   return record
 

@@ -51,7 +51,7 @@ def main(argv: Optional[List[str]] = None) -> int:
   rules = default_rules()
   if args.list_rules:
     for rule in rules:
-      print(f"{rule.name:<20}{rule.description}")
+      print(f"{rule.name:<22}{rule.description}")
     return 0
 
   default_scan = not args.paths

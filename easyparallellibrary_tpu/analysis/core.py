@@ -64,6 +64,7 @@ RULE_DONATION = "donation-after-use"
 RULE_METRIC_SCHEMA = "metric-schema"
 RULE_SPAN_PAIRING = "span-pairing"
 RULE_LOCK_DISCIPLINE = "lock-discipline"
+RULE_DEVICE_INTROSPECTION = "device-introspection"
 RULE_SUPPRESSION = "suppression"
 
 # Rule ids may contain hyphens ("recompile-hazard"), so a bare "-"

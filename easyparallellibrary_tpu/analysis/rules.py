@@ -36,6 +36,11 @@ correctness or performance bug on some path a test does not reach:
   written under it everywhere (outside ``__init__``), and the
   monitor-thread entry paths (``threading.Thread(target=self.X)``)
   never write shared attributes without it.
+* ``device-introspection`` — ``cost_analysis``/``memory_analysis``/
+  ``memory_stats`` (and ``.lower()`` on a jit alias) only in the
+  observability//profiler/ homes, never on the serving/training hot
+  paths and never inside a loop: device introspection is warmup-time
+  work (observability/device.py cost cards), not a per-step activity.
 
 All analysis is intra-module (plus package-wide span pairing): the
 rules trade whole-program soundness for zero-setup precision on this
@@ -50,9 +55,9 @@ import dataclasses
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from easyparallellibrary_tpu.analysis.core import (
-    RULE_DONATION, RULE_HOST_SYNC, RULE_LOCK_DISCIPLINE,
-    RULE_METRIC_SCHEMA, RULE_RECOMPILE, RULE_SPAN_PAIRING, AnalysisContext,
-    Finding, ModuleInfo, Rule)
+    RULE_DEVICE_INTROSPECTION, RULE_DONATION, RULE_HOST_SYNC,
+    RULE_LOCK_DISCIPLINE, RULE_METRIC_SCHEMA, RULE_RECOMPILE,
+    RULE_SPAN_PAIRING, AnalysisContext, Finding, ModuleInfo, Rule)
 
 # Fallback when the scanned tree does not include observability/registry.py
 # (fixture runs); the real run parses the authoritative tuple from source.
@@ -900,6 +905,83 @@ class SpanPairingRule(Rule):
     self._ends.clear()
 
 
+# ------------------------------------------------ device-introspection --
+
+
+# Compiled/runtime introspection entry points (observability/device.py
+# owns their use; profiler/ is the legacy warmup-tooling home).
+_INTROSPECTION_ATTRS = ("cost_analysis", "memory_analysis",
+                        "memory_stats")
+# Modules where introspection LIVES — exempt from the rule entirely.
+_INTROSPECTION_HOMES = ("observability/", "profiler/")
+
+
+class DeviceIntrospectionRule(Rule):
+  """Device introspection (``cost_analysis``/``memory_analysis``/
+  ``memory_stats``) is warmup-time observability: one AOT compile read
+  per twin, one host RPC per gauge sample.  On the serving/training hot
+  paths it is a per-step stall the PR-14 introspector exists to avoid —
+  engines hand their twins to ``observability/device.py`` at warmup and
+  never introspect inline.  The rule flags (a) ANY introspection call
+  in a hot module (serving/, runtime/loop.py), (b) introspection inside
+  a loop anywhere outside the observability//profiler/ homes, and (c)
+  ``.lower(...)`` on a known jit alias in a hot module (re-lowering a
+  compiled twin inline is the same stall by another name — shares the
+  host-sync rule's jit-alias index)."""
+
+  name = RULE_DEVICE_INTROSPECTION
+  description = ("cost_analysis/memory_analysis/memory_stats only in "
+                 "observability//profiler/ and warmup paths, never on "
+                 "the per-step hot loop")
+
+  def check_module(self, mod: ModuleInfo, ctx: AnalysisContext
+                   ) -> Iterator[Finding]:
+    path = mod.path.replace("\\", "/")
+    if any(h in path for h in _INTROSPECTION_HOMES):
+      return
+    hot = ("serving/" in path
+           or any(path.endswith(s) for s in _HOT_SUFFIXES))
+    index = jit_index(mod)
+    for qual, cls, fn in _iter_functions(mod.tree):
+      loop_nodes: Set[int] = set()
+      for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.While)):
+          for sub in ast.walk(node):
+            if sub is not node:
+              loop_nodes.add(id(sub))
+      for node in ast.walk(fn):
+        if not isinstance(node, ast.Call) \
+            or not isinstance(node.func, ast.Attribute):
+          continue
+        attr = node.func.attr
+        if attr in _INTROSPECTION_ATTRS:
+          if hot:
+            yield Finding(
+                self.name, mod.rel, node.lineno, node.col_offset,
+                f".{attr}() on a hot path: device introspection "
+                f"belongs in observability/device.py (warmup cost-card "
+                f"capture / gauge sampling), never inline in the "
+                f"serving or training step")
+          elif id(node) in loop_nodes:
+            yield Finding(
+                self.name, mod.rel, node.lineno, node.col_offset,
+                f".{attr}() inside a loop: per-iteration device "
+                f"introspection stalls the very program it describes — "
+                f"capture once at warmup (observability/device.py)")
+        elif attr == "lower" and hot:
+          # Re-lowering a compiled twin inline: resolve the receiver
+          # through the shared jit-alias index (the expression the
+          # .lower is called ON must itself be a known jit wrapper).
+          probe = ast.Call(func=node.func.value, args=[], keywords=[])
+          if index.lookup_call(probe, qual, cls) is not None:
+            yield Finding(
+                self.name, mod.rel, node.lineno, node.col_offset,
+                f".lower() on the jit alias "
+                f"{_unparse(node.func.value)!r} in a hot module: AOT "
+                f"introspection of a compiled twin belongs in "
+                f"observability/device.py's warmup capture")
+
+
 # ------------------------------------------------------ lock-discipline --
 
 
@@ -1079,4 +1161,5 @@ def default_rules() -> List[Rule]:
       MetricSchemaRule(),
       SpanPairingRule(),
       LockDisciplineRule(),
+      DeviceIntrospectionRule(),
   ]

@@ -254,7 +254,8 @@ def resolve_num_chunks(kind: str, axis_n: int, *,
                        m: int, k: int, n_out: int,
                        dtype=jnp.bfloat16,
                        config=None,
-                       measured_collective_bytes=None) -> int:
+                       measured_collective_bytes=None,
+                       site: Optional[str] = None) -> int:
   """Chunk count the ``communication.overlap`` policy picks for one
   collective-matmul site: 0/1 = fused, >= 2 = ring with that many
   chunks.
@@ -268,6 +269,17 @@ def resolve_num_chunks(kind: str, axis_n: int, *,
   ``measured_collective_bytes`` feeds a profiler-measured wire-traffic
   figure for this site into the crossover instead of the analytic
   derivation (ROADMAP item 5c; the analytic model stays the fallback).
+
+  ``site`` is the call site's canonical name
+  (``parallel.planner.OVERLAP_SITES``): when given and no explicit
+  measurement was passed, the device introspector's per-site
+  measurement store is consulted automatically — a warmup capture that
+  attributed this site's fused collective flips the crossover onto
+  evidence with zero caller plumbing (observability/device.py; when
+  device observability is off the lookup is a constant-time None and
+  the decision is bit-identical to the analytic one).  The site is
+  also REGISTERED with its analytic signature here, which is how the
+  introspector knows what to attribute in the first place.
   """
   if axis_n <= 1:
     return 1
@@ -282,6 +294,14 @@ def resolve_num_chunks(kind: str, axis_n: int, *,
   if policy == "on":
     return normalize_chunks(requested if requested > 1 else axis_n, axis_n)
   # auto
+  if site is not None:
+    from easyparallellibrary_tpu.observability import device as device_lib
+    device_lib.register_site(
+        site, kind=kind, axis_n=axis_n, m=m, k=k, n_out=n_out,
+        dtype_bytes=jnp.dtype(dtype).itemsize)
+    if measured_collective_bytes is None:
+      measured_collective_bytes = device_lib.measured_collective_bytes(
+          site)
   from easyparallellibrary_tpu.parallel.planner import plan_collective_matmul
   decision = plan_collective_matmul(
       kind, m=m, k=k, n_out=n_out, axis_size=axis_n,

@@ -680,11 +680,41 @@ class ObservabilityConfig(_Category):
       # replica).  Written under <bundle>/xla.  Off by default: device
       # captures are heavy.
       "slo.capture_xla": False,
+      # Breach when any local device's bytes_in_use / bytes_limit
+      # exceeds this fraction (0 = rule off).  Fed by the device
+      # introspector's HBM gauges (observability/device.py) — only
+      # backends whose memory_stats() reports a limit ever produce the
+      # hbm_frac metric, so the rule is naturally inert on CPU.
+      "slo.hbm_frac": 0.0,
+      # --- Device-truth introspection (observability/device.py,
+      # docs/observability.md "Device truth").  Master switch: at
+      # warmup every compiled twin's cost/memory analysis is captured
+      # into a CostCard (flops, wire bytes per overlap site, static HBM
+      # plan, donation-verified), HBM watermark gauges ride the serving
+      # stats cadence, and measured per-site collective bytes feed the
+      # overlap planner automatically.  Off by default: capture pays
+      # one extra (AOT) compile per twin at warmup.
+      "device.enabled": False,
+      # Sample jax.local_devices()[i].memory_stats() (static cost-card
+      # bound where unavailable) into observability/device/* gauges +
+      # Perfetto counters on the engine's stats cadence.
+      "device.hbm_gauges": True,
+      # Feed introspector-measured per-SITE collective bytes into
+      # communicators.overlap.resolve_num_chunks (analytic fallback
+      # preserved; ROADMAP item 5c).
+      "device.site_feed": True,
+      # Also dump every captured cost card to this JSON path (atomic
+      # rewrite per capture; "" = memory only).
+      "device.cards_path": "",
   }
 
   @property
   def slo(self) -> _SubGroup:
     return _SubGroup(self, "slo")
+
+  @property
+  def device(self) -> _SubGroup:
+    return _SubGroup(self, "device")
 
 
 class Config:
@@ -884,6 +914,10 @@ class Config:
       raise ValueError(
           f"observability.slo.capture_ring_tail must be >= 1; got "
           f"{slo.capture_ring_tail}")
+    if not 0.0 <= slo.hbm_frac < 1.0:
+      raise ValueError(
+          f"observability.slo.hbm_frac must be in [0, 1) (0 = rule "
+          f"off); got {slo.hbm_frac}")
     if spec.enabled and spec.k + 1 > self.serving.prefill_chunk:
       raise ValueError(
           f"serving.speculative.k={spec.k} needs serving.prefill_chunk "

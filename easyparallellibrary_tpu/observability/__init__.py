@@ -14,12 +14,22 @@ One event substrate for the whole runtime:
   serving timelines (``--follow`` tails a live metrics JSONL);
 * :mod:`slo` — declarative SLO rules over the registry records, the
   always-on compile sentinel, and anomaly-triggered diagnostic-bundle
-  capture (``observability.slo.*``).
+  capture (``observability.slo.*``);
+* :mod:`device` — device-truth introspection: compiled-twin cost cards
+  (``Compiled.cost_analysis()``/``memory_analysis()`` at warmup),
+  per-site measured collective bytes feeding the overlap planner, and
+  HBM watermark gauges (``observability.device.*``);
+* :mod:`perfgate` — ``make perf-gate``: cost-card and
+  BENCH_EVIDENCE.json invariants pinned in ``perf_budget.json``,
+  failing CI-style on regression.
 
 Knobs: the ``observability.*`` config group (enabled / trace_path /
-ring_capacity / sample_rate / metrics_jsonl / slo.*).
+ring_capacity / sample_rate / metrics_jsonl / slo.* / device.*).
 """
 
+from easyparallellibrary_tpu.observability.device import (
+    CostCard, DeviceIntrospector, get_introspector,
+)
 from easyparallellibrary_tpu.observability.registry import (
     NAMESPACES, MetricRegistry, split_namespaces,
 )
@@ -33,8 +43,8 @@ from easyparallellibrary_tpu.observability.trace import (
 
 __all__ = [
     "MetricRegistry", "NAMESPACES", "split_namespaces",
-    "BurnRateRule", "CompileSentinel", "DiagnosticCapture",
-    "SLOMonitor", "SLORule", "get_monitor",
-    "Tracer", "ensure_configured", "get_tracer", "install",
-    "validate_trace",
+    "BurnRateRule", "CompileSentinel", "CostCard", "DeviceIntrospector",
+    "DiagnosticCapture", "SLOMonitor", "SLORule", "get_introspector",
+    "get_monitor", "Tracer", "ensure_configured", "get_tracer",
+    "install", "validate_trace",
 ]

@@ -1,0 +1,567 @@
+"""Device-truth observability: compiled-program introspection, per-site
+measured collective bytes, and HBM watermark gauges.
+
+The tracing/SLO stack (observability/trace.py, slo.py) sees the HOST
+side of every step: spans bracket ``device_step``, counters track what
+the scheduler planned.  What XLA actually compiled — flops, bytes moved
+per collective, peak HBM, whether donation really aliased — stayed a
+black box, and the overlap planner's measured-bytes input
+(``plan_collective_matmul(measured_collective_bytes=...)``, ROADMAP
+item 5c) had nothing feeding it.  This module is the measured half of
+that loop:
+
+* :class:`CostCard` — one compiled twin's device truth, captured ONCE
+  at warmup from the ahead-of-time introspection surface
+  (``jit(f).lower(specs).compile()`` → ``cost_analysis()`` /
+  ``memory_analysis()``): flops, bytes accessed, per-collective-op wire
+  bytes (attributed to registered overlap SITES), the static HBM plan
+  (argument/output/temp/alias bytes and their peak-bound sum), and a
+  donation-verified flag (``alias_size_in_bytes > 0`` — the compiler's
+  own word that the donated buffers really aliased, not just that the
+  caller asked).  AOT lowering traces ABSTRACT values
+  (:func:`specs_of` ShapeDtypeStructs), so capture never touches live
+  buffers, never transfers device->host (transfer-guard clean), and
+  never grows the twin's jit call cache — the compile sentinel stays
+  silent (pinned in tests/test_observability_device.py).
+* **Per-site measured collective bytes** — overlap call sites register
+  themselves through :func:`resolve_num_chunks(site=...)
+  <easyparallellibrary_tpu.communicators.overlap.resolve_num_chunks>`
+  (the planner's site naming, ``parallel.planner.OVERLAP_SITES``); when
+  a captured program contains the site's fused collective, its RESULT
+  bytes are matched back to the site and converted to ring wire bytes,
+  and the next resolution consumes the measurement automatically
+  (:func:`measured_collective_bytes`).  The measurement is SITE-scoped
+  — never the whole-program aggregate ``FlopsProfiler`` counts — and
+  the analytic derivation stays the fallback whenever no measurement
+  exists (bit-identical decisions, pinned).
+* **HBM watermark gauges** — :meth:`DeviceIntrospector.hbm_gauges`
+  reads ``jax.local_devices()[i].memory_stats()`` where the backend
+  provides it (TPU/GPU: live + peak + limit, so ``hbm_frac`` feeds the
+  ``observability.slo.hbm_frac`` rule), and degrades to the cost
+  cards' static plan bound elsewhere (CPU: ``memory_stats() is None``
+  — the gauge still reports the compiled twins' worst-case footprint,
+  it just cannot see allocator churn).  Sampled on the engine's
+  existing stats cadence and published under the
+  ``observability/device/*`` registry namespace, as Perfetto counter
+  tracks, and into diagnostic bundles.
+
+Ambient wiring mirrors the tracer/monitor contract
+(:func:`ensure_configured` reconciles with ``observability.device.*``;
+:func:`install` pins an explicit introspector for tests).  Capture is
+defensive end to end: introspection describes the program, it must
+never take the program down — every capture failure degrades to a
+logged skip.  Lint: ``cost_analysis``/``memory_analysis``/
+``memory_stats`` calls are allowed HERE (and in profiler/) and nowhere
+on the serving/training hot paths — epl-lint's ``device-introspection``
+rule enforces the boundary statically (docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from easyparallellibrary_tpu.utils.logging import get_logger
+
+# Which fused StableHLO op a site's collective lowers to when the
+# overlap policy picks the fused program (the form capture can match —
+# an already-ringed site shows collective_permutes and stays analytic).
+_SITE_FUSED_OP = {
+    "all_gather_matmul": "all_gather",
+    "matmul_reduce_scatter": "reduce_scatter",
+    "reduce_scatter": "reduce_scatter",
+}
+
+# A parsed collective matches a site only when its result bytes sit
+# within this factor of the site's expected fused result — close enough
+# to be the site, far enough to tolerate padding/layout slop.
+_MATCH_FACTOR = 1.5
+
+
+@dataclasses.dataclass
+class SiteInfo:
+  """One registered overlap site: the analytic signature
+  ``resolve_num_chunks`` saw, kept so captured programs can be matched
+  back to the site that will consume the measurement."""
+  site: str
+  kind: str
+  axis_n: int
+  m: int
+  k: int
+  n_out: int
+  dtype_bytes: int
+
+  def expected_result_bytes(self) -> float:
+    """Result-tensor bytes of this site's FUSED collective (what the
+    StableHLO text sizes ops by; wire bytes are derived from it)."""
+    n = max(self.axis_n, 1)
+    if self.kind == "all_gather_matmul":
+      return float(n * self.m * self.k * self.dtype_bytes)
+    if self.kind == "matmul_reduce_scatter":
+      return float(self.m / n * self.n_out * self.dtype_bytes)
+    return float(self.m / n * self.k * self.dtype_bytes)
+
+  def wire_bytes_from_result(self, result_bytes: float) -> float:
+    """Ring wire bytes implied by a matched fused result: an all_gather
+    moves (n-1)/n of its gathered result past each device; a
+    reduce_scatter moves (n-1) copies of its scattered block."""
+    n = max(self.axis_n, 1)
+    if self.kind == "all_gather_matmul":
+      return result_bytes * (n - 1) / n
+    return result_bytes * (n - 1)
+
+
+@dataclasses.dataclass
+class CostCard:
+  """Device truth for one compiled twin, captured at warmup."""
+  label: str                       # twin label (serving/fused_step, ...)
+  flops: float = 0.0
+  bytes_accessed: float = 0.0
+  collective_wire_bytes: float = 0.0   # sum over collective ops
+  collective_ops: int = 0
+  site_bytes: Dict[str, float] = dataclasses.field(default_factory=dict)
+  argument_bytes: float = 0.0
+  output_bytes: float = 0.0
+  temp_bytes: float = 0.0
+  alias_bytes: float = 0.0
+  generated_code_bytes: float = 0.0
+  peak_hbm_bytes: float = 0.0      # static plan bound: args + temp + out
+  donation_requested: bool = False
+  donation_verified: bool = False
+  compile_count: int = 0           # twin's jit cache size at capture
+  meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+  def metrics(self) -> Dict[str, float]:
+    """Flat numeric view for the registry / perf gate (host floats
+    only; ``meta`` numeric entries ride along)."""
+    out = {
+        "flops": self.flops,
+        "bytes_accessed": self.bytes_accessed,
+        "collective_wire_bytes": self.collective_wire_bytes,
+        "collective_ops": float(self.collective_ops),
+        "argument_bytes": self.argument_bytes,
+        "output_bytes": self.output_bytes,
+        "temp_bytes": self.temp_bytes,
+        "alias_bytes": self.alias_bytes,
+        "peak_hbm_bytes": self.peak_hbm_bytes,
+        "donation_verified": float(self.donation_verified),
+        "compile_count": float(self.compile_count),
+    }
+    for k, v in self.meta.items():
+      if isinstance(v, (int, float)) and not isinstance(v, bool):
+        out[k] = float(v)
+    if self.meta.get("tokens_per_step"):
+      out["flops_per_token"] = (
+          self.flops / float(self.meta["tokens_per_step"]))
+    return out
+
+  def summary(self) -> Dict[str, Any]:
+    d = self.metrics()
+    d["label"] = self.label
+    if self.site_bytes:
+      d["site_bytes"] = dict(self.site_bytes)
+    return d
+
+
+def specs_of(args) -> Tuple:
+  """ShapeDtypeStruct pytree mirroring ``args`` — the abstract twin the
+  AOT capture lowers, so live (possibly donated) buffers are never held
+  or read.  Host scalars/arrays pass through unchanged (lowering treats
+  them as it would the originals)."""
+  import jax
+
+  def spec(x):
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+      return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    return x
+
+  return jax.tree_util.tree_map(spec, args)
+
+
+class DeviceIntrospector:
+  """Warmup-time compiled-program introspection + the per-site
+  measurement store + HBM gauges (module docstring).  Thread-safe: the
+  stores are lock-guarded (capture may run on an engine thread while a
+  watchdog-triggered bundle reads the summary)."""
+
+  def __init__(self, hbm_gauges: bool = True, site_feed: bool = True,
+               cards_path: str = ""):
+    self.hbm_gauges_enabled = hbm_gauges
+    self.site_feed = site_feed
+    self.cards_path = cards_path
+    self.cards: Dict[str, CostCard] = {}
+    self.captures = 0
+    self.capture_failures = 0
+    self._sites: Dict[str, SiteInfo] = {}
+    self._measured: Dict[str, float] = {}
+    self._lock = threading.Lock()
+    self._fail_logged: set = set()
+
+  # ------------------------------------------------------------- sites
+
+  def register_site(self, site: str, *, kind: str, axis_n: int, m: int,
+                    k: int, n_out: int, dtype_bytes: int) -> None:
+    """Record one overlap site's analytic signature (called from
+    ``resolve_num_chunks``) so later captures can attribute the site's
+    fused collective back to it."""
+    if not self.site_feed:
+      return
+    with self._lock:
+      self._sites[site] = SiteInfo(site, kind, int(axis_n), int(m),
+                                   int(k), int(n_out), int(dtype_bytes))
+
+  def record_site_bytes(self, site: str, wire_bytes: float) -> None:
+    """Store a measured per-step wire-byte figure for ``site`` — the
+    value the next ``resolve_num_chunks(site=...)`` consumes in place
+    of the analytic derivation."""
+    with self._lock:
+      self._measured[site] = float(wire_bytes)
+
+  def measured_site_bytes(self, site: str) -> Optional[float]:
+    with self._lock:
+      return self._measured.get(site)
+
+  def sites(self) -> Dict[str, SiteInfo]:
+    with self._lock:
+      return dict(self._sites)
+
+  def measured(self) -> Dict[str, float]:
+    with self._lock:
+      return dict(self._measured)
+
+  def _attribute_sites(self, ops: List[Tuple[str, float]]
+                       ) -> Dict[str, float]:
+    """Match parsed collective ops to registered sites by expected
+    fused-result bytes; claimed ops feed the measurement store.  Sites
+    with no plausible match stay unmeasured (analytic fallback) —
+    attribution must never guess."""
+    with self._lock:
+      sites = list(self._sites.values())
+    if not sites or not ops:
+      return {}
+    available = list(ops)
+    matched: Dict[str, float] = {}
+    for info in sites:
+      want_op = _SITE_FUSED_OP.get(info.kind)
+      expected = info.expected_result_bytes()
+      if want_op is None or expected <= 0:
+        continue
+      best_i, best_ratio = -1, _MATCH_FACTOR
+      for i, (op, result) in enumerate(available):
+        if op != want_op or result <= 0:
+          continue
+        ratio = max(result / expected, expected / result)
+        if ratio <= best_ratio:
+          best_i, best_ratio = i, ratio
+      if best_i < 0:
+        continue
+      _op, result = available.pop(best_i)
+      matched[info.site] = info.wire_bytes_from_result(result)
+    if matched:
+      with self._lock:
+        self._measured.update(matched)
+    return matched
+
+  # ----------------------------------------------------------- capture
+
+  def has_card(self, label: str) -> bool:
+    with self._lock:
+      return label in self.cards
+
+  def card(self, label: str) -> Optional[CostCard]:
+    with self._lock:
+      return self.cards.get(label)
+
+  def capture_twin(self, label: str, fn, arg_specs,
+                   compile_count: Optional[int] = None,
+                   meta: Optional[Mapping[str, Any]] = None
+                   ) -> Optional[CostCard]:
+    """Introspect one compiled twin through the AOT surface and record
+    its :class:`CostCard`.  ``fn`` is the twin's ``jax.jit`` wrapper;
+    ``arg_specs`` the :func:`specs_of` tree of one real call's
+    arguments.  Idempotent per label; never raises (a failed capture
+    logs once per label and serving continues)."""
+    with self._lock:
+      if label in self.cards:
+        return self.cards[label]
+    try:
+      card = self._capture(label, fn, arg_specs, compile_count, meta)
+    except Exception as e:  # noqa: BLE001 — introspection must not crash
+      self.capture_failures += 1
+      if label not in self._fail_logged:
+        self._fail_logged.add(label)
+        get_logger().warning(
+            "device introspection of twin %s failed (%s: %s); cost card "
+            "skipped (logged once)", label, type(e).__name__, e)
+      return None
+    with self._lock:
+      self.cards[label] = card
+      self.captures += 1
+    self._emit(card)
+    self._dump_cards()
+    return card
+
+  def _capture(self, label, fn, arg_specs, compile_count, meta
+               ) -> CostCard:
+    from easyparallellibrary_tpu.profiler.flops import collective_op_sizes
+    lowered = fn.lower(*arg_specs)
+    text = lowered.as_text()
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):  # some backends return per-computation
+      cost = cost[0] if cost else {}
+    cost = dict(cost or {})
+    mem = compiled.memory_analysis()
+    ops = collective_op_sizes(text)
+    site_bytes = self._attribute_sites(ops)
+    requested = "tf.aliasing_output" in text or "jax.buffer_donor" in text
+    card = CostCard(
+        label=label,
+        flops=float(cost.get("flops", 0.0)),
+        bytes_accessed=float(cost.get("bytes accessed", 0.0)),
+        collective_ops=len(ops),
+        site_bytes=site_bytes,
+        donation_requested=requested,
+        compile_count=int(compile_count or 0),
+        meta=dict(meta or {}))
+    if mem is not None:
+      card.argument_bytes = float(
+          getattr(mem, "argument_size_in_bytes", 0) or 0)
+      card.output_bytes = float(
+          getattr(mem, "output_size_in_bytes", 0) or 0)
+      card.temp_bytes = float(getattr(mem, "temp_size_in_bytes", 0) or 0)
+      card.alias_bytes = float(
+          getattr(mem, "alias_size_in_bytes", 0) or 0)
+      card.generated_code_bytes = float(
+          getattr(mem, "generated_code_size_in_bytes", 0) or 0)
+      # Static plan bound: everything the program holds at once minus
+      # what aliases onto its own inputs (donated buffers are not paid
+      # twice) — the compiler's worst case, not allocator truth.
+      card.peak_hbm_bytes = max(
+          card.argument_bytes + card.temp_bytes + card.output_bytes
+          - card.alias_bytes, 0.0)
+      card.donation_verified = card.alias_bytes > 0
+    else:
+      # No memory plan on this backend: the donation flag falls back to
+      # the lowered text's aliasing annotation (request == verification
+      # is the best this backend can attest).
+      card.donation_verified = requested
+    # Wire bytes summed over every collective the program holds — the
+    # whole-program figure (FlopsProfiler's comm counter analog); the
+    # SITE split above is what the overlap planner consumes.
+    card.collective_wire_bytes = float(sum(b for _o, b in ops))
+    return card
+
+  def _emit(self, card: CostCard) -> None:
+    from easyparallellibrary_tpu.observability import trace as trace_lib
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      args = {k: v for k, v in card.summary().items()
+              if isinstance(v, (int, float, str))}
+      tracer.instant("device/cost_card", cat="device", track="device",
+                     args=args)
+      tracer.counter("device/twin_flops", card.flops)
+      tracer.counter("device/twin_peak_hbm_bytes", card.peak_hbm_bytes)
+    get_logger().info(
+        "device cost card %s: %.3g flops, %.3g bytes accessed, "
+        "%.3g peak HBM (static), %d collective op(s), donation %s",
+        card.label, card.flops, card.bytes_accessed, card.peak_hbm_bytes,
+        card.collective_ops,
+        "verified" if card.donation_verified else
+        ("NOT aliased" if card.donation_requested else "not requested"))
+
+  def _dump_cards(self) -> None:
+    if not self.cards_path:
+      return
+    try:
+      with self._lock:
+        doc = {label: card.summary()
+               for label, card in sorted(self.cards.items())}
+        doc["sites"] = {s: dataclasses.asdict(i)
+                        for s, i in sorted(self._sites.items())}
+        doc["measured_site_bytes"] = dict(self._measured)
+      tmp = self.cards_path + ".tmp"
+      os.makedirs(os.path.dirname(os.path.abspath(self.cards_path)),
+                  exist_ok=True)
+      with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+      os.replace(tmp, self.cards_path)
+    except OSError as e:
+      get_logger().warning("device cost-card dump to %s failed: %s",
+                           self.cards_path, e)
+
+  # -------------------------------------------------------- HBM gauges
+
+  def hbm_gauges(self) -> Dict[str, Any]:
+    """Current HBM watermarks as host floats.  ``memory_stats()``-
+    backed where the runtime provides it (live/peak/limit + the
+    ``hbm_frac`` the SLO rule consumes); elsewhere the cost cards'
+    static plan bound with ``hbm_source = "cost_card"`` (and no frac —
+    a bound over no limit is not an occupancy)."""
+    import jax
+    try:
+      devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no backend, no gauges
+      return {}
+    in_use = peak = limit = 0.0
+    live = False
+    for d in devices:
+      try:
+        stats = d.memory_stats()
+      except Exception:  # noqa: BLE001
+        stats = None
+      if not stats:
+        continue
+      live = True
+      in_use += float(stats.get("bytes_in_use", 0) or 0)
+      peak = max(peak, float(stats.get("peak_bytes_in_use", 0) or 0))
+      limit += float(stats.get("bytes_limit", 0) or 0)
+    if live:
+      out = {"hbm_bytes_in_use": in_use, "hbm_peak_bytes": peak,
+             "hbm_bytes_limit": limit, "hbm_source": "memory_stats"}
+      if limit > 0:
+        out["hbm_frac"] = in_use / limit
+      return out
+    with self._lock:
+      bound = max((c.peak_hbm_bytes for c in self.cards.values()),
+                  default=0.0)
+    if bound <= 0:
+      return {}
+    return {"hbm_bytes_in_use": bound, "hbm_peak_bytes": bound,
+            "hbm_bytes_limit": 0.0, "hbm_source": "cost_card"}
+
+  def publish_hbm(self, step: int, registry=None, monitor=None) -> None:
+    """Publish the gauges under ``observability/device/*`` (registry
+    when present — the SLO monitor rides it as a sink — else straight
+    to the monitor) and as Perfetto counter tracks.  Host floats only;
+    a gaugeless backend publishes nothing."""
+    if not self.hbm_gauges_enabled:
+      return
+    gauges = self.hbm_gauges()
+    if not gauges:
+      return
+    from easyparallellibrary_tpu.observability import trace as trace_lib
+    from easyparallellibrary_tpu.observability.registry import (
+        DEVICE_NAMESPACE, MetricRegistry)
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      tracer.counter("device/hbm_bytes_in_use",
+                     gauges["hbm_bytes_in_use"])
+      tracer.counter("device/hbm_peak_bytes", gauges["hbm_peak_bytes"])
+    numeric = {k: v for k, v in gauges.items()
+               if isinstance(v, (int, float))}
+    if registry is not None:
+      registry.publish(step, numeric, DEVICE_NAMESPACE)
+    elif monitor is not None:
+      monitor.observe(step,
+                      MetricRegistry.namespaced(DEVICE_NAMESPACE, numeric))
+
+  # ----------------------------------------------------------- context
+
+  def context(self) -> Dict[str, Any]:
+    """Diagnostic-bundle summary (DiagnosticCapture context provider):
+    every card plus the live gauges and the site measurement store."""
+    with self._lock:
+      cards = {label: card.summary()
+               for label, card in sorted(self.cards.items())}
+      measured = dict(self._measured)
+    out: Dict[str, Any] = {"cost_cards": cards}
+    if measured:
+      out["measured_site_bytes"] = measured
+    gauges = self.hbm_gauges()
+    if gauges:
+      out["hbm"] = gauges
+    if self.capture_failures:
+      out["capture_failures"] = self.capture_failures
+    return out
+
+
+# --------------------------------------------------- ambient introspector
+
+
+_introspector: Optional[DeviceIntrospector] = None
+_auto_sig: Optional[Tuple] = None
+
+
+def get_introspector() -> Optional[DeviceIntrospector]:
+  """The ambient introspector, or None when device observability is
+  off."""
+  return _introspector
+
+
+def install(intro: Optional[DeviceIntrospector]
+            ) -> Optional[DeviceIntrospector]:
+  """Pin an explicit introspector (None = uninstall); wins over
+  config."""
+  global _introspector, _auto_sig
+  _introspector = intro
+  _auto_sig = None
+  return intro
+
+
+def reset():
+  """Drop any ambient introspector (tests)."""
+  install(None)
+
+
+def ensure_configured(config=None) -> Optional[DeviceIntrospector]:
+  """Reconcile the ambient introspector with
+  ``config.observability.device`` — the tracer/monitor contract:
+  explicit :func:`install` wins, and only the AMBIENT Env config may
+  tear down or rebuild an auto-built instance (rebuilding drops the
+  cards and the site measurement store)."""
+  global _introspector, _auto_sig
+  if _introspector is not None and _auto_sig is None:
+    return _introspector  # explicit install wins
+  from easyparallellibrary_tpu.env import Env
+  if config is None:
+    config = Env.get().config
+    ambient = True
+  else:
+    ambient = config is Env.get().config
+  dev = config.observability.device
+  if not dev.enabled:
+    if _auto_sig is not None and ambient:
+      _introspector = None
+      _auto_sig = None
+    return _introspector
+  sig = (dev.hbm_gauges, dev.site_feed, dev.cards_path)
+  if _introspector is not None and (_auto_sig == sig or not ambient):
+    return _introspector
+  _introspector = DeviceIntrospector(
+      hbm_gauges=dev.hbm_gauges, site_feed=dev.site_feed,
+      cards_path=dev.cards_path)
+  _auto_sig = sig
+  get_logger().info(
+      "device introspector: hbm gauges %s, site feed %s, cards -> %s",
+      "on" if dev.hbm_gauges else "off",
+      "on" if dev.site_feed else "off", dev.cards_path or "(memory only)")
+  return _introspector
+
+
+# Module-level conveniences the overlap policy calls (cheap no-ops when
+# device observability is off — the policy must not pay for plumbing).
+
+
+def measured_collective_bytes(site: str) -> Optional[float]:
+  """The measured per-step wire bytes for one overlap site, or None
+  when device observability is off or the site is unmeasured — the
+  automatic feed behind ``resolve_num_chunks(site=...)`` (analytic
+  fallback preserved)."""
+  intro = _introspector
+  if intro is None:
+    return None
+  return intro.measured_site_bytes(site)
+
+
+def register_site(site: str, *, kind: str, axis_n: int, m: int, k: int,
+                  n_out: int, dtype_bytes: int) -> None:
+  """Register one overlap site's analytic signature with the ambient
+  introspector (no-op when off)."""
+  intro = _introspector
+  if intro is not None:
+    intro.register_site(site, kind=kind, axis_n=axis_n, m=m, k=k,
+                        n_out=n_out, dtype_bytes=dtype_bytes)

@@ -1,0 +1,352 @@
+"""Perf regression gate: device-truth cost-card and benchmark-evidence
+invariants pinned in ``perf_budget.json`` (``make perf-gate``).
+
+BENCH_EVIDENCE.json was a write-only ledger: every benchmark appended
+evidence and nothing ever READ it, so a PR could double a twin's flops
+or regress a pinned episode and no gate noticed until a human re-ran a
+benchmark on a quiet box.  This module closes that: a checked-in budget
+file pins
+
+* **cost-card invariants** — per compiled twin (the deterministic tiny
+  reference geometry :func:`collect_cards` builds), bounds on the
+  numbers XLA itself reports at warmup via the device introspector
+  (observability/device.py): ``compile_count`` (the compile-once
+  contract as a number), ``flops_per_token``, ``kv_bytes_per_request``,
+  the static ``peak_hbm_bytes`` plan, and ``donation_verified``.  These
+  are COMPILER facts, not wall clocks — they are bit-stable on a noisy
+  1-core box, which is exactly why they gate where timing cannot.
+* **benchmark-evidence invariants** — selected structural metrics from
+  the latest BENCH_EVIDENCE.json record per pinned name (a failover
+  episode losing zero requests, the observability overhead staying
+  within budget).  Records are validated against the evidence schema
+  FIRST (``utils.bench_evidence.validate_record``) and a malformed
+  record FAILS the gate — refused, never silently skipped.
+
+Budget entry forms (``perf_budget.json``)::
+
+    {"version": 1,
+     "cost_cards": {
+       "<twin label>": {"<metric>": {"max": 1.0}            # <= bound
+                        | {"min": 1.0}                      # >= bound
+                        | {"max": ..., "min": ...}}},
+     "bench": [
+       {"metric": "<record name>", "path": "kill.orphans_after",
+        "op": "<=", "target": 0}]}
+
+Bounds are written pre-inflated (``--write-budget`` applies the
+per-metric tolerances below to the measured values), so the check
+itself is a plain comparison.  Exit status is CI-shaped: 0 clean, 1 on
+any violation, with one ``path: got vs bound`` line each.
+
+Run: ``python -m easyparallellibrary_tpu.observability.perfgate``
+(``make perf-gate``; ``make gate`` chains epl-lint first).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from easyparallellibrary_tpu.utils.logging import get_logger
+
+_OPS = {
+    "<=": lambda v, t: v <= t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    ">": lambda v, t: v > t,
+    "==": lambda v, t: v == t,
+}
+
+# Tolerance applied per cost-card metric when GENERATING a budget from
+# measured cards (--write-budget): the bound ships pre-inflated so the
+# gate is a plain compare.  compile_count and donation_verified are
+# exact — a second compile or a lost alias IS the regression.
+_CARD_TOLERANCE = {
+    "compile_count": 0.0,
+    "donation_verified": 0.0,
+    "flops_per_token": 0.10,
+    "flops": 0.10,
+    "kv_bytes_per_request": 0.10,
+    "peak_hbm_bytes": 0.25,
+}
+# Metrics the generated budget pins per twin (when the card carries
+# them); max-bounded except donation_verified, which is min-bounded.
+_CARD_PINNED = ("compile_count", "flops_per_token", "flops",
+                "kv_bytes_per_request", "peak_hbm_bytes")
+
+_DEFAULT_BUDGET = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "perf_budget.json")
+
+
+def default_budget_path() -> str:
+  return os.environ.get("EPL_PERF_BUDGET", _DEFAULT_BUDGET)
+
+
+def load_budget(path: Optional[str] = None) -> Dict[str, Any]:
+  path = path or default_budget_path()
+  with open(path, encoding="utf-8") as f:
+    doc = json.load(f)
+  if not isinstance(doc, dict):
+    raise ValueError(f"perf budget {path!r} is not a JSON object")
+  return doc
+
+
+# ------------------------------------------------------ card collection
+
+
+def collect_cards(twins: Tuple[str, ...] = ("plain", "guarded", "paged")
+                  ) -> Dict[str, Dict[str, float]]:
+  """Capture cost cards for the canonical reference twins on THIS
+  backend: deterministic ``testing.factories.tiny_gpt`` engines, each
+  serving one seeded request so warmup capture fires.  Returns
+  ``{twin label: flat metrics dict}`` — the measured side the budget's
+  ``cost_cards`` section compares against.
+
+  The geometry is pinned (it IS the budget's reference program): any
+  change here invalidates the checked-in budget and must regenerate it
+  (``--write-budget``)."""
+  import numpy as np
+
+  from easyparallellibrary_tpu.observability import device as device_lib
+  from easyparallellibrary_tpu.serving import (
+      ContinuousBatchingEngine, Request)
+  from easyparallellibrary_tpu.testing.factories import tiny_gpt
+
+  previous = device_lib.get_introspector()
+  intro = device_lib.install(device_lib.DeviceIntrospector())
+  try:
+    model, params = tiny_gpt()
+    variants = {
+        "plain": dict(resilience=False, track_prefix="serving"),
+        "guarded": dict(resilience=True,
+                        track_prefix="serving/guarded"),
+        "paged": dict(resilience=False, paged=True, block_size=8,
+                      track_prefix="serving/paged"),
+    }
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, 64, (5,)).astype(np.int32)
+    for name in twins:
+      kw = variants[name]
+      eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                     prefill_chunk=4, speculative=False,
+                                     **kw)
+      try:
+        eng.submit(Request(uid=f"gate-{name}", prompt=prompt,
+                           max_new_tokens=3))
+        eng.run()
+      finally:
+        eng.close()
+    return {label: card.metrics()
+            for label, card in sorted(intro.cards.items())}
+  finally:
+    device_lib.install(previous)
+
+
+# ------------------------------------------------------------ checking
+
+
+def _check_bound(path: str, value: Any, bound: Dict[str, Any]
+                 ) -> List[str]:
+  if isinstance(value, bool):
+    value = float(value)
+  if not isinstance(value, (int, float)):
+    return [f"{path}: measured value {value!r} is not numeric"]
+  errs = []
+  if "max" in bound and value > bound["max"]:
+    errs.append(f"{path}: {value:g} exceeds budget max {bound['max']:g}")
+  if "min" in bound and value < bound["min"]:
+    errs.append(f"{path}: {value:g} below budget min {bound['min']:g}")
+  return errs
+
+
+def check_cost_cards(budget: Dict[str, Any],
+                     cards: Dict[str, Dict[str, float]]) -> List[str]:
+  """Violations of the budget's ``cost_cards`` section against measured
+  cards.  A budgeted twin or metric that was NOT measured is a
+  violation — a gate that cannot see a pinned number has not passed
+  it."""
+  errs: List[str] = []
+  for label, pins in (budget.get("cost_cards") or {}).items():
+    card = cards.get(label)
+    if card is None:
+      errs.append(f"cost_cards[{label}]: twin not captured "
+                  f"(collection geometry changed?)")
+      continue
+    for metric, bound in pins.items():
+      if metric not in card:
+        errs.append(f"cost_cards[{label}].{metric}: metric missing "
+                    f"from the captured card")
+        continue
+      errs.extend(_check_bound(f"cost_cards[{label}].{metric}",
+                               card[metric], bound))
+  return errs
+
+
+def _resolve_path(record: Dict[str, Any], dotted: str) -> Any:
+  cur: Any = record
+  for part in dotted.split("."):
+    if not isinstance(cur, dict) or part not in cur:
+      return None
+    cur = cur[part]
+  return cur
+
+
+def check_bench(budget: Dict[str, Any],
+                evidence_path: Optional[str] = None) -> List[str]:
+  """Violations of the budget's ``bench`` section against the latest
+  BENCH_EVIDENCE.json record per pinned metric.  EVERY record in the
+  ledger is schema-validated first; malformed records are refused as
+  violations, never silently skipped."""
+  from easyparallellibrary_tpu.utils import bench_evidence
+  errs: List[str] = []
+  records = bench_evidence.load_records(evidence_path)
+  for i, rec in enumerate(records):
+    for problem in bench_evidence.validate_record(rec):
+      errs.append(
+          f"bench evidence record #{i} "
+          f"({rec.get('metric') if isinstance(rec, dict) else '?'}): "
+          f"malformed — {problem}")
+  by_name: Dict[str, Dict[str, Any]] = {}
+  for rec in records:
+    if not isinstance(rec, dict):
+      continue
+    name = rec.get("metric")
+    prev = by_name.get(name)
+    if prev is None or (rec.get("unix_time", 0)
+                        > prev.get("unix_time", 0)):
+      by_name[name] = rec
+  for entry in budget.get("bench") or ():
+    name, dotted = entry["metric"], entry["path"]
+    op, target = entry.get("op", "<="), entry["target"]
+    where = f"bench[{name}].{dotted}"
+    rec = by_name.get(name)
+    if rec is None:
+      errs.append(f"{where}: no evidence record named {name!r}")
+      continue
+    value = _resolve_path(rec, dotted)
+    if isinstance(value, bool):
+      value = float(value)
+    if not isinstance(value, (int, float)):
+      errs.append(f"{where}: path missing or non-numeric "
+                  f"(got {value!r})")
+      continue
+    if op not in _OPS:
+      errs.append(f"{where}: unknown op {op!r}")
+      continue
+    if not _OPS[op](value, target):
+      errs.append(f"{where}: {value:g} violates '{op} {target:g}'")
+  return errs
+
+
+def run_gate(budget_path: Optional[str] = None,
+             evidence_path: Optional[str] = None,
+             cards: Optional[Dict[str, Dict[str, float]]] = None
+             ) -> List[str]:
+  """The whole gate: load the budget, collect (or accept) measured
+  cards, check both sections.  Returns every violation."""
+  budget = load_budget(budget_path)
+  errs: List[str] = []
+  if budget.get("cost_cards"):
+    if cards is None:
+      cards = collect_cards()
+    errs.extend(check_cost_cards(budget, cards))
+  errs.extend(check_bench(budget, evidence_path))
+  return errs
+
+
+# ----------------------------------------------------------- generation
+
+
+def generate_budget(cards: Dict[str, Dict[str, float]],
+                    bench: Optional[List[Dict[str, Any]]] = None
+                    ) -> Dict[str, Any]:
+  """A budget document pinning ``cards`` with the standard tolerances
+  (the ``--write-budget`` path; the checked-in starter budget was
+  produced exactly this way)."""
+  cost_cards: Dict[str, Any] = {}
+  for label, metrics in sorted(cards.items()):
+    pins: Dict[str, Any] = {}
+    for metric in _CARD_PINNED:
+      if metric not in metrics:
+        continue
+      tol = _CARD_TOLERANCE.get(metric, 0.25)
+      bound = metrics[metric] * (1.0 + tol)
+      pins[metric] = {"max": round(bound, 4)}
+    if metrics.get("donation_verified") is not None:
+      pins["donation_verified"] = {"min": metrics["donation_verified"]}
+    cost_cards[label] = pins
+  return {
+      "version": 1,
+      "comment": "Perf budget: cost-card + bench-evidence invariants "
+                 "enforced by `make perf-gate` (observability/"
+                 "perfgate.py).  Regenerate with --write-budget ONLY "
+                 "when a perf change is intentional, and say why in "
+                 "the PR.",
+      "cost_cards": cost_cards,
+      "bench": bench if bench is not None else _DEFAULT_BENCH_PINS,
+  }
+
+
+# Structural (non-wall-clock) evidence pins for the starter budget:
+# episodes must keep resolving every request, flagging zero recompiles,
+# leaking zero orphans, and closing the self-healing loop.
+_DEFAULT_BENCH_PINS: List[Dict[str, Any]] = [
+    {"metric": "observability_overhead", "path": "recompiles_flagged",
+     "op": "<=", "target": 0},
+    {"metric": "observability_overhead", "path": "within_5pct",
+     "op": ">=", "target": 1},
+    {"metric": "router_failover_process", "path": "kill.orphans_after",
+     "op": "<=", "target": 0},
+    {"metric": "router_failover_process", "path": "kill.kills",
+     "op": ">=", "target": 1},
+    {"metric": "self_heal", "path": "self_healing.scale_ups",
+     "op": ">=", "target": 1},
+    {"metric": "self_heal", "path": "self_healing.slo_recoveries",
+     "op": ">=", "target": 1},
+]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m easyparallellibrary_tpu.observability.perfgate",
+      description="Perf regression gate over device cost cards and "
+                  "BENCH_EVIDENCE.json (perf_budget.json)")
+  parser.add_argument("--budget", default=None,
+                      help="budget file (default: repo perf_budget.json)")
+  parser.add_argument("--evidence", default=None,
+                      help="evidence file (default: BENCH_EVIDENCE.json)")
+  parser.add_argument("--write-budget", action="store_true",
+                      help="regenerate the budget from freshly "
+                           "collected cards (tolerances applied) "
+                           "instead of checking")
+  args = parser.parse_args(argv)
+  budget_path = args.budget or default_budget_path()
+  if args.write_budget:
+    cards = collect_cards()
+    doc = generate_budget(cards)
+    with open(budget_path, "w", encoding="utf-8") as f:
+      json.dump(doc, f, indent=1, sort_keys=False)
+      f.write("\n")
+    print(f"perf budget written: {budget_path} "
+          f"({len(doc['cost_cards'])} twin(s), "
+          f"{len(doc['bench'])} bench pin(s))")
+    return 0
+  violations = run_gate(budget_path, args.evidence)
+  if violations:
+    print(f"perf-gate: {len(violations)} violation(s):")
+    for v in violations:
+      print(f"  FAIL {v}")
+    return 1
+  budget = load_budget(budget_path)
+  print(f"perf-gate: OK ({len(budget.get('cost_cards') or {})} twin(s), "
+        f"{len(budget.get('bench') or ())} bench pin(s))")
+  return 0
+
+
+if __name__ == "__main__":
+  get_logger().setLevel("WARNING")
+  sys.exit(main())

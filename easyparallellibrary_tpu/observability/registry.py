@@ -26,6 +26,10 @@ namespace             producers
                       (comm_gb_per_step, comm_share)
 ``resilience/*``      sentinel bad-step counters, IO retries, rollbacks,
                       watchdog timeouts
+``observability/*``   the observability layer's own device-truth channel
+                      (``observability/device/*``: compiled-twin cost
+                      cards, HBM watermark gauges —
+                      observability/device.py)
 ====================  ====================================================
 
 Publishing is buffer-friendly: values pass through RAW (device arrays
@@ -39,7 +43,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Mapping
 
-NAMESPACES = ("train", "serving", "comm", "resilience")
+NAMESPACES = ("train", "serving", "comm", "resilience", "observability")
 
 # Well-known sub-namespaces, shared so producers (serving/router.py)
 # and consumers (observability/report.py's rollup/--follow readers)
@@ -48,6 +52,10 @@ NAMESPACES = ("train", "serving", "comm", "resilience")
 # the roots above.
 SERVING_NAMESPACE = "serving"
 FLEET_NAMESPACE = "serving/fleet"
+# Device-truth channel (observability/device.py): compiled-twin cost
+# cards and HBM watermark gauges — what XLA/the runtime report, never
+# host-planned quantities (those belong to the producer namespaces).
+DEVICE_NAMESPACE = "observability/device"
 
 # The key->namespace rule for producers that accumulate one flat mixed
 # metrics dict (fit's step metrics, the profilers' summaries).  Shared

@@ -784,6 +784,12 @@ def rules_from_config(slo_conf) -> List[Any]:
     # Fleet availability: any replica down is a breach window — the
     # serving/fleet/* rollup carries the per-state counts.
     rules.append(SLORule("replica_down", "replicas_down", "<=", 0.0))
+  if slo_conf.hbm_frac > 0:
+    # Device-memory headroom: the introspector's HBM gauges
+    # (observability/device.py) publish hbm_frac only on backends whose
+    # memory_stats() reports a limit, so the rule is inert elsewhere.
+    rules.append(SLORule("hbm_high", "hbm_frac", "<=",
+                         slo_conf.hbm_frac))
   return rules
 
 
@@ -813,7 +819,7 @@ def ensure_configured(config=None) -> Optional[SLOMonitor]:
     return _monitor
   sig = (slo.events_path, slo.ttft_p99_s, slo.itl_p99_s,
          slo.shed_objective, slo.fast_window, slo.slow_window,
-         slo.fast_burn, slo.slow_burn, slo.replicas_down,
+         slo.fast_burn, slo.slow_burn, slo.replicas_down, slo.hbm_frac,
          slo.capture_dir, slo.capture_limit, slo.capture_min_interval_s,
          slo.capture_ring_tail)
   if _monitor is not None and (_auto_sig == sig or not ambient):

@@ -52,12 +52,13 @@ def gather_matmul(x, w, axis_name: str = constants.SEQ_AXIS,
   layer whose output must see every token.  Ring-overlapped per the
   overlap policy; bit-exact vs the fused gather+matmul."""
   from easyparallellibrary_tpu.communicators import overlap
+  from easyparallellibrary_tpu.parallel.planner import SITE_GATHER_MATMUL
   from easyparallellibrary_tpu.utils.compat import axis_size
   n = axis_size(axis_name)
   if num_chunks is None:
     num_chunks = overlap.resolve_num_chunks(
         "all_gather_matmul", n, m=x.shape[0], k=x.shape[1],
-        n_out=w.shape[1], dtype=x.dtype)
+        n_out=w.shape[1], dtype=x.dtype, site=SITE_GATHER_MATMUL)
   return overlap.all_gather_matmul(x, w, axis_name, num_chunks=num_chunks)
 
 
@@ -68,11 +69,12 @@ def matmul_scatter(x, w, axis_name: str = constants.SEQ_AXIS,
   back to token shards.  Ring-overlapped per the overlap policy; exact to
   accumulation-order tolerance vs the fused matmul+psum_scatter."""
   from easyparallellibrary_tpu.communicators import overlap
+  from easyparallellibrary_tpu.parallel.planner import SITE_MATMUL_SCATTER
   from easyparallellibrary_tpu.utils.compat import axis_size
   n = axis_size(axis_name)
   if num_chunks is None:
     num_chunks = overlap.resolve_num_chunks(
         "matmul_reduce_scatter", n, m=x.shape[0], k=x.shape[1],
-        n_out=w.shape[1], dtype=x.dtype)
+        n_out=w.shape[1], dtype=x.dtype, site=SITE_MATMUL_SCATTER)
   return overlap.matmul_reduce_scatter(x, w, axis_name,
                                        num_chunks=num_chunks)

@@ -97,9 +97,10 @@ def _row_overlap_chunks(x, padded_in: int, out_features: int) -> int:
   if rows % n:
     return 1
   from easyparallellibrary_tpu.communicators import overlap as _overlap
+  from easyparallellibrary_tpu.parallel.planner import SITE_ROW_DENSE
   return _overlap.resolve_num_chunks(
       "matmul_reduce_scatter", n, m=rows, k=padded_in // n,
-      n_out=out_features, dtype=x.dtype)
+      n_out=out_features, dtype=x.dtype, site=SITE_ROW_DENSE)
 
 
 def _row_overlap_matmul(x, kernel, dtype, num_chunks: int):

@@ -413,9 +413,11 @@ def _zero1_overlap_chunks(G, dims, dp: int) -> int:
   if not total:
     return 1
   from easyparallellibrary_tpu.communicators import overlap
+  from easyparallellibrary_tpu.parallel.planner import (
+      SITE_ZERO1_REDUCE_SCATTER)
   return overlap.resolve_num_chunks(
       "reduce_scatter", dp, m=dp, k=max(total // dp, 1), n_out=0,
-      dtype=dtype, config=config)
+      dtype=dtype, config=config, site=SITE_ZERO1_REDUCE_SCATTER)
 
 
 def _reduce_grads(G, stage_psum, mean_axes, zero1):

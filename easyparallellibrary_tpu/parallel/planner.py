@@ -99,6 +99,23 @@ class AutoStageGenerator:
 # Collective-matmul overlap crossover (communicators/overlap.py's policy).
 # ---------------------------------------------------------------------------
 
+# Canonical overlap-site names — the planner OWNS the site naming so
+# the measurement half of the loop (observability/device.py: per-site
+# measured collective bytes registered/consumed through
+# ``resolve_num_chunks(site=...)``) and the call sites themselves
+# (ops/layers.py, ops/distributed_ops.py, parallel/pipeline_smap.py)
+# never drift on the string.  A site is one decomposition adjacency in
+# the program, not one tensor: every row-parallel Dense shares
+# SITE_ROW_DENSE, so a measurement there describes the per-layer wire
+# traffic of that adjacency, which is exactly the quantity
+# ``plan_collective_matmul``'s crossover trades against MXU time.
+SITE_ROW_DENSE = "layers/row_dense"
+SITE_GATHER_MATMUL = "distributed_ops/gather_matmul"
+SITE_MATMUL_SCATTER = "distributed_ops/matmul_scatter"
+SITE_ZERO1_REDUCE_SCATTER = "pipeline_smap/zero1_reduce_scatter"
+OVERLAP_SITES = (SITE_ROW_DENSE, SITE_GATHER_MATMUL,
+                 SITE_MATMUL_SCATTER, SITE_ZERO1_REDUCE_SCATTER)
+
 # Defaults for the analytic model.  ICI link bandwidth is the per-chip
 # bidirectional ring figure public TPU specs quote (~100 GB/s is the v4
 # per-link order of magnitude); the per-ring-step latency covers permute

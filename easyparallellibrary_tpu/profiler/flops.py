@@ -72,18 +72,18 @@ _DTYPE_BYTES = {"f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8": 1,
                 "ui16": 2, "i8": 1, "ui8": 1, "i1": 1}
 
 
-def collective_bytes(fn: Callable, *args, **kwargs) -> float:
-  """Bytes produced by collective ops in the lowered program of
-  ``fn(*args)`` — the comm-traffic counter feeding the profiler's
-  comm-share line.  Counted from the StableHLO text (result tensor types
-  of all_gather / all_reduce / reduce_scatter / collective_permute /
-  all_to_all), the same program the XLA cost model scores, so the flops
-  and comm numbers describe one artifact."""
+def collective_op_sizes(text: str) -> "list[tuple[str, float]]":
+  """``(op_kind, result_bytes)`` for every collective op in a StableHLO
+  program text, in program order — the per-op split behind
+  :func:`collective_bytes`, and the raw material the device
+  introspector's per-SITE attribution works from
+  (observability/device.py): one entry per all_gather / all_reduce /
+  reduce_scatter / collective_permute / all_to_all, sized by its result
+  tensor type."""
   import re
   global _TENSOR_RE
   if _TENSOR_RE is None:
     _TENSOR_RE = re.compile(r"tensor<([0-9x]*)x?([a-z]+[0-9]+)>")
-  text = jax.jit(fn).lower(*args, **kwargs).as_text()
 
   def result_bytes(tail: str) -> float:
     sub = 0.0
@@ -95,29 +95,41 @@ def collective_bytes(fn: Callable, *args, **kwargs) -> float:
       sub += elems * _DTYPE_BYTES.get(dtype, 4)
     return sub
 
-  total = 0.0
-  awaiting_close = False
+  out: "list[tuple[str, float]]" = []
+  awaiting: Optional[str] = None
   for line in text.splitlines():
-    if awaiting_close:
+    if awaiting is not None:
       # Region-bearing collectives (all_reduce/reduce_scatter carry a
       # reduction body) print their type signature on the CLOSING
       # `}) : (...) -> ...` line, not the op line — count it there and
       # ignore the body lines in between.
       if "})" in line and "->" in line:
-        total += result_bytes(line.rsplit("->", 1)[-1])
-        awaiting_close = False
+        out.append((awaiting, result_bytes(line.rsplit("->", 1)[-1])))
+        awaiting = None
       continue
-    if not any(f"stablehlo.{op}" in line or f'"{op}"' in line
-               for op in _COLLECTIVE_OPS):
+    hit = next((op for op in _COLLECTIVE_OPS
+                if f"stablehlo.{op}" in line or f'"{op}"' in line), None)
+    if hit is None:
       continue
     if "->" in line:
       # Inline form: result type follows the last `->`.  (Attribute
       # tensors like replica_groups sit BEFORE the arrow and are not
       # counted.)
-      total += result_bytes(line.rsplit("->", 1)[-1])
+      out.append((hit, result_bytes(line.rsplit("->", 1)[-1])))
     else:
-      awaiting_close = True
-  return total
+      awaiting = hit
+  return out
+
+
+def collective_bytes(fn: Callable, *args, **kwargs) -> float:
+  """Bytes produced by collective ops in the lowered program of
+  ``fn(*args)`` — the comm-traffic counter feeding the profiler's
+  comm-share line.  Counted from the StableHLO text (result tensor types
+  of all_gather / all_reduce / reduce_scatter / collective_permute /
+  all_to_all), the same program the XLA cost model scores, so the flops
+  and comm numbers describe one artifact."""
+  text = jax.jit(fn).lower(*args, **kwargs).as_text()
+  return float(sum(b for _op, b in collective_op_sizes(text)))
 
 
 def estimate_mfu(flops_per_step: float, step_time_s: float,

@@ -79,6 +79,14 @@ def fit(step_fn: Callable,
   res = config.resilience
   obs = config.observability
   tracer = trace_lib.ensure_configured(config)
+  # Device-truth introspection (observability/device.py): with
+  # observability.device.enabled the first dispatched step's compiled
+  # program is captured into a train/fit_step cost card (flops, wire
+  # bytes, static HBM plan, donation-verified) and the HBM gauges ride
+  # the periodic log cadence.
+  from easyparallellibrary_tpu.observability import device as device_lib
+  introspector = device_lib.ensure_configured(config)
+  fit_step_captured = False
   rng = rng if rng is not None else jax.random.PRNGKey(0)
   start_step = int(state.step) if hasattr(state, "step") else 0
 
@@ -326,12 +334,30 @@ def fit(step_fn: Callable,
                 "data iterator exhausted and could not be restarted; "
                 "pass a re-iterable (list) or a zero-arg iterator "
                 "factory to fit() for multi-epoch runs") from None
+      step_specs = None
+      if introspector is not None and not fit_step_captured:
+        # Abstract specs BEFORE the dispatch — a donating step's inputs
+        # must still exist when described (shapes/dtypes only).
+        step_specs = device_lib.specs_of(
+            (state, batch, jax.random.fold_in(rng, step_idx)))
       # The span measures DISPATCH (async): device time surfaces at the
       # next host sync, which the flush/log spans below then cover.
       with tracer.span("train/step_dispatch", cat="train", track="train",
                        record=step_rec):
         state, metrics = step_fn(state, batch,
                                  jax.random.fold_in(rng, step_idx))
+      if step_specs is not None:
+        # Warmup cost card for the fit step (capture_twin is defensive:
+        # a step_fn without the AOT surface — a plain function, a chaos
+        # wrapper — degrades to a logged skip).  parallelize() wrappers
+        # expose the underlying jit as `.jitted` (same arg signature —
+        # the wrapper passes straight through).
+        fit_step_captured = True
+        introspector.capture_twin("train/fit_step",
+                                  getattr(step_fn, "jitted", step_fn),
+                                  step_specs, compile_count=1)
+        if own_registry is not None:
+          introspector.publish_hbm(step_idx + 1, registry=own_registry)
       if watchdog is not None:
         watchdog.disarm()
       if check_every and (step_idx + 1) % check_every == 0 \
@@ -403,6 +429,11 @@ def fit(step_fn: Callable,
         with tracer.span("train/metrics_flush", cat="train",
                          track="train", record=step_rec):
           own_registry.publish_many(step_idx + 1, split_namespaces(out))
+      if (introspector is not None and own_registry is not None
+          and log_every and (step_idx + 1) % log_every == 0):
+        # HBM watermark gauges on the periodic log cadence (the
+        # training twin of the serving engine's stats-cadence sample).
+        introspector.publish_hbm(step_idx + 1, registry=own_registry)
       if log_every and (step_idx + 1) % log_every == 0:
         # float(loss) is the loop's periodic host sync point.
         with tracer.span("train/host_sync", cat="train", track="train",

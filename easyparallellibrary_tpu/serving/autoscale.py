@@ -14,12 +14,18 @@ window).  This module is only the policy that connects them:
   a replica the autoscaler ITSELF previously drained rejoins WARM
   (compiled step and cache intact — the cheapest capacity in the
   fleet; an OPERATOR-drained replica is maintenance in progress and is
-  never silently reverted), else a new replica is built cold through
-  :meth:`Router.add_replica` (a REAL subprocess spawn on the process
-  transport — synchronous, like every router action: the sweep blocks
-  for the spawn, the same cost the breaker's respawn probe already
-  pays; an off-thread spawn with the replica unroutable until ready is
-  the ROADMAP follow-up);
+  never silently reverted), else a new replica is built cold
+  OFF-THREAD: :meth:`Router.build_replica` (the REAL subprocess spawn
+  + in-child compile on the process transport) runs on a background
+  spawner thread while the sweep keeps serving, and the finished
+  replica is adopted (:meth:`Router.adopt_replica` — appended,
+  health-tracked, parked backlog flushed) at the next sweep boundary.
+  The replica is UNROUTABLE until adopted (it simply is not in the
+  fleet yet), at most one spawn is in flight (further grow impulses
+  hold), and a failed spawn counts a ``spawn_failures`` — never a flap
+  (a flap trip requires a grow that LANDED).  Fleets without a build
+  recipe (injected test replicas) fall back to the synchronous
+  :meth:`Router.add_replica` lever;
 * **shrink** — once the error budget has recovered (no relevant breach
   for ``scale_down_cooldown_s``), gracefully :meth:`drain` the
   youngest-added live replica back out, never below ``min_replicas``;
@@ -100,6 +106,19 @@ class FleetAutoscaler:
     self._lock = threading.Lock()
     self._pending_rule: Optional[str] = None
     self._last_breach_t: Optional[float] = None
+    # Off-thread cold spawn (module docstring): at most one in flight;
+    # a single LONG-LIVED daemon spawner thread serves build requests
+    # and posts outcomes here for the router thread to adopt (or book
+    # the failure) at the next on_step.  The thread must outlive every
+    # child it spawns: Linux delivers PR_SET_PDEATHSIG when the thread
+    # that forked the child EXITS, so a short-lived per-spawn thread
+    # would SIGKILL its own replica the moment it finished — and a
+    # daemon thread dying only at process exit turns that same signal
+    # into exactly the orphan reaping the transport wants.
+    self._spawn_thread: Optional[threading.Thread] = None
+    self._spawn_queue = None
+    self._spawn_busy = False
+    self._spawn_outcome: Optional[tuple] = None
     monitor = router._slo
     from easyparallellibrary_tpu.observability.slo import BreachPressure
     self._probe = BreachPressure(
@@ -167,6 +186,15 @@ class FleetAutoscaler:
         self._last_breach_t = self.clock()
     return pressured
 
+  @property
+  def spawn_in_flight(self) -> bool:
+    """True while an off-thread cold spawn is running or its outcome
+    has not yet been landed by :meth:`on_step` — drivers that want the
+    scale-up to complete keep sweeping (idle sweeps are heartbeats)
+    while this holds."""
+    with self._lock:
+      return self._spawn_busy or self._spawn_outcome is not None
+
   def scale_up_holdout_s(self) -> float:
     """Current scale-up hold-out: the base cooldown doubled per flap
     trip (capped) — PR 8's breaker shape applied to capacity."""
@@ -174,9 +202,14 @@ class FleetAutoscaler:
         2 ** min(self.flap_trips, _MAX_FLAP_DOUBLINGS))
 
   def on_step(self, now: Optional[float] = None) -> None:
-    """One fleet-sweep boundary: act on a recorded breach (grow), or on
-    a recovered budget (shrink), honoring bounds/cooldowns/hold-outs."""
+    """One fleet-sweep boundary: land any finished off-thread spawn,
+    then act on a recorded breach (grow) or on a recovered budget
+    (shrink), honoring bounds/cooldowns/hold-outs."""
     now = self.clock() if now is None else now
+    with self._lock:
+      outcome, self._spawn_outcome = self._spawn_outcome, None
+    if outcome is not None:
+      self._finish_spawn(outcome, now)
     if self._parked:
       # A parked claim is valid only while the drain THIS policy
       # started is still in effect: the moment a parked replica leaves
@@ -225,6 +258,13 @@ class FleetAutoscaler:
       self._maybe_scale_down(now)
 
   def _maybe_scale_up(self, rule: str, now: float) -> None:
+    with self._lock:
+      spawning = self._spawn_busy or self._spawn_outcome is not None
+    if spawning:
+      # One capacity action in flight: further grow impulses hold until
+      # the spawner thread's outcome lands at a sweep boundary.
+      self.holds += 1
+      return
     live = self._live()
     if len(live) >= self.max_replicas:
       self.holds += 1
@@ -233,8 +273,6 @@ class FleetAutoscaler:
         and now - self._last_up_t < self.scale_up_holdout_s()):
       self.holds += 1
       return
-    flapped = (self._last_down_t is not None
-               and now - self._last_down_t < self.flap_window_s)
     router = self.router
     # Cheapest capacity first: a replica THIS policy drained rejoins
     # WARM.  Operator-drained replicas are maintenance in progress —
@@ -247,33 +285,98 @@ class FleetAutoscaler:
         self.holds += 1
         return
       self._parked.remove(index)
-      action = "rejoin"
-    else:
+      self._land_grow(index, "rejoin", rule, now)
+      return
+    if getattr(router, "spawn_recipe_available", False):
+      # Cold spawn OFF the sweep thread (ROADMAP item 5 leftover
+      # closed): the subprocess spawn + in-child compile can take
+      # seconds, and a synchronous add would stall every live replica
+      # for exactly the window the fleet is overloaded.  The new
+      # replica is unroutable until adoption lands it at a later
+      # sweep.
+      self._start_spawn(rule)
+      return
+    # No build recipe (injected test fleets): the synchronous operator
+    # lever is the only grow path.
+    try:
+      index = router.add_replica()
+    except Exception as e:  # noqa: BLE001 — a failed spawn must not
+      self.spawn_failures += 1          # take the control plane down
+      get_logger().error(
+          "autoscale: replica spawn failed (%s: %s); holding",
+          type(e).__name__, e)
+      # Stamp AFTER the failed attempt (same rule as the success
+      # path): a spawn that blocked until spawn_timeout_s must buy a
+      # full cooldown of actual serving before the retry, not an
+      # immediate back-to-back doomed attempt.
+      self._last_up_t = self.clock()
+      return
+    self._land_grow(index, "spawn", rule, now)
+
+  def _start_spawn(self, rule: str) -> None:
+    """Queue the cold spawn onto the persistent daemon spawner thread
+    (init comment on ``_spawn_thread``: the forking thread must outlive
+    the child, or PDEATHSIG kills the fresh replica the moment the
+    thread exits).  The thread only calls :meth:`Router.build_replica`
+    (recipe reads + the subprocess spawn — no router-list mutation) and
+    posts the outcome for :meth:`on_step` to land on the router's
+    thread."""
+    import queue
+    with self._lock:
+      if self._spawn_thread is None or not self._spawn_thread.is_alive():
+        self._spawn_queue = queue.Queue()
+        self._spawn_thread = threading.Thread(
+            target=self._spawner_loop, name="epl-autoscale-spawner",
+            daemon=True)
+        self._spawn_thread.start()
+      self._spawn_busy = True
+    self._spawn_queue.put(rule)
+    get_logger().info(
+        "autoscale: cold replica spawn started off-thread (rule %s); "
+        "fleet keeps sweeping, replica unroutable until ready", rule)
+
+  def _spawner_loop(self) -> None:
+    while True:
+      rule = self._spawn_queue.get()
       try:
-        index = router.add_replica()
-      except Exception as e:  # noqa: BLE001 — a failed spawn must not
-        self.spawn_failures += 1          # take the control plane down
-        get_logger().error(
-            "autoscale: replica spawn failed (%s: %s); holding",
-            type(e).__name__, e)
-        # Stamp AFTER the failed attempt (same rule as the success
-        # path): a spawn that blocked until spawn_timeout_s must buy a
-        # full cooldown of actual serving before the retry, not an
-        # immediate back-to-back doomed attempt.
-        self._last_up_t = self.clock()
-        return
-      action = "spawn"
+        rep, err = self.router.build_replica(), None
+      except Exception as e:  # noqa: BLE001 — posted, booked on_step
+        rep, err = None, e
+      with self._lock:
+        self._spawn_outcome = (rep, err, rule)
+        self._spawn_busy = False
+
+  def _finish_spawn(self, outcome, now: float) -> None:
+    rep, err, rule = outcome
+    if err is not None:
+      # A failed spawn is booked exactly like the synchronous path:
+      # counted, cooled down — and NEVER a flap (no grow landed).
+      self.spawn_failures += 1
+      get_logger().error(
+          "autoscale: off-thread replica spawn failed (%s: %s); holding",
+          type(err).__name__, err)
+      self._last_up_t = self.clock()
+      return
+    index = self.router.adopt_replica(rep)
+    self._land_grow(index, "spawn", rule, now)
+
+  def _land_grow(self, index: int, action: str, rule: str,
+                 now: float) -> None:
+    """Book one grow that LANDED (warm rejoin, sync spawn, or adopted
+    off-thread spawn): ownership, flap accounting, cooldown stamp,
+    emission."""
     if index not in self._added:
       # Autoscaler-owned capacity (spawned OR rejoined into service):
       # exactly the set shrink may later drain back out.
       self._added.append(index)
-    if flapped:
+    if (self._last_down_t is not None
+        and now - self._last_down_t < self.flap_window_s):
       # Growing right after shrinking — and only when the grow actually
       # LANDED: the load is oscillating around the capacity step, so
       # the next hold-out doubles (a failed spawn is not a flap).
       self.flap_trips = min(self.flap_trips + 1, _MAX_FLAP_DOUBLINGS)
     self.scale_ups += 1
-    # Stamp AFTER the action: a cold spawn blocks for seconds, and a
+    # Stamp AFTER the action: a cold spawn takes seconds, and a
     # cooldown counted from before it would let the very next sweep
     # read the whole spawn as "quiet" and drain the replica right back.
     self._last_up_t = self.clock()

@@ -68,6 +68,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from easyparallellibrary_tpu.env import Env
+from easyparallellibrary_tpu.observability import device as device_lib
 from easyparallellibrary_tpu.observability import slo as slo_lib
 from easyparallellibrary_tpu.observability import trace as trace_lib
 from easyparallellibrary_tpu.observability.registry import (
@@ -216,6 +217,13 @@ class ContinuousBatchingEngine:
     # serving without any wiring at the call site.
     trace_lib.ensure_configured(root_config)
     self._slo = slo_lib.ensure_configured(root_config)
+    # Device-truth introspection (observability/device.py): warmup
+    # capture of every compiled twin's cost/memory analysis, HBM
+    # watermark gauges on the stats cadence, and the per-site measured
+    # collective-bytes feed.  None when observability.device is off —
+    # every hook below is then a cheap attribute test.
+    self._introspector = device_lib.ensure_configured(root_config)
+    self._pending_step_specs = None
     self._capture_xla = root_config.observability.slo.capture_xla
     self._pending_xla_dir: Optional[str] = None
     check_servable(cfg)
@@ -389,6 +397,17 @@ class ContinuousBatchingEngine:
                    >= start[:, None, None, None]),
                 jnp.zeros((), x.dtype), x), kv),
         donate_argnums=0) if self._resilient else None
+    if self._sanitize_fn is not None and self._introspector is not None:
+      # The sanitize twin's cost card, captured here (its first real
+      # dispatch is a fault — warmup must not wait for one).  Abstract
+      # specs only: the live cache is never read.
+      rows = self.num_blocks if self.paged else self.num_slots
+      self._introspector.capture_twin(
+          f"{self._track_prefix}/sanitize", self._sanitize_fn,
+          device_lib.specs_of(
+              (self._kv, np.zeros((rows,), bool),
+               np.zeros((rows,), np.int32))),
+          compile_count=1)
     # Perfetto track name per slot (the scheduler's lifecycle spans and
     # the engine's per-step spans must land on the same track);
     # precomputed so the per-step tracing loop does no string work.
@@ -564,7 +583,39 @@ class ContinuousBatchingEngine:
                  kv_fragmentation=sched.kv_fragmentation,
                  preemptions=sched.preemptions,
                  proactive_preemptions=sched.proactive_preemptions)
-    return {self._track_prefix: ctx}
+    out = {self._track_prefix: ctx}
+    if self._introspector is not None:
+      # Device truth rides every diagnostic bundle: cost cards, live
+      # HBM gauges, the per-site measurement store.  The introspector
+      # is ambient (shared across replicas), so one "device" key
+      # carries the whole picture.
+      out["device"] = self._introspector.context()
+    return out
+
+  def _note_step_specs(self, step_args) -> None:
+    """Snapshot the warmup call's abstract argument specs (shapes and
+    dtypes only — donated buffers are never held) so the device
+    introspector can capture this twin's cost card AFTER the step
+    completes; no-op past warmup or with device observability off."""
+    if (self._introspector is not None and self._steps == 0
+        and self._pending_step_specs is None
+        and not self._introspector.has_card(self._twin_label)):
+      self._pending_step_specs = device_lib.specs_of(step_args)
+
+  def _twin_meta(self) -> Dict[str, Any]:
+    """Geometry the perf gate normalizes cost-card numbers by: the
+    step's token capacity and the KV footprint per request."""
+    cfg = self.model.cfg
+    if self.paged:
+      kv_bytes = kv_lib.paged_cache_bytes(cfg, self.num_blocks,
+                                          self.block_size)
+      tokens = self.token_budget
+    else:
+      kv_bytes = kv_lib.cache_bytes(cfg, self.num_slots, self.chunk)
+      tokens = self.num_slots * self.chunk
+    return {"tokens_per_step": tokens, "kv_cache_bytes": kv_bytes,
+            "kv_bytes_per_request": kv_bytes / max(self.num_slots, 1),
+            "num_slots": self.num_slots, "paged": self.paged}
 
   def _arm_xla_capture(self, rule: str, payload: Dict[str, Any]) -> None:
     """Breach listener (observability.slo.capture_xla): arm a
@@ -1129,22 +1180,26 @@ class ContinuousBatchingEngine:
         t0_us = tracer.now_us()
         if self.paged:
           base_last = (plan.base_idx + plan.num_valid - 1).astype(np.int32)
-          out = self._step_fn(
+          step_args = (
               self.params, self._kv, plan.tokens, plan.slot_ids,
               plan.positions, plan.valid, plan.block_tables, base_last,
               plan.draft_base, num_draft, plan.num_valid > 0, plan.keys,
               plan.tok_index, plan.temperature, plan.top_k, plan.top_p)
+          self._note_step_specs(step_args)
+          out = self._step_fn(*step_args)
           if self._resilient:
             committed, n_committed, ok_dev, self._kv = out
             slot_ok = jax.device_get(ok_dev)
           else:
             committed, n_committed, self._kv = out
         else:
-          out = self._step_fn(
+          step_args = (
               self.params, self._kv, self._cursors, plan.tokens,
               plan.num_valid + num_draft, num_draft, plan.reset,
               plan.keys, plan.tok_index, plan.temperature, plan.top_k,
               plan.top_p)
+          self._note_step_specs(step_args)
+          out = self._step_fn(*step_args)
           if self._resilient:
             committed, n_committed, ok_dev, self._kv, self._cursors = out
             slot_ok = jax.device_get(ok_dev)
@@ -1179,21 +1234,25 @@ class ContinuousBatchingEngine:
         t0_us = tracer.now_us()
         if self.paged:
           last_idx = (plan.base_idx + plan.num_valid - 1).astype(np.int32)
-          out = self._step_fn(
+          step_args = (
               self.params, self._kv, plan.tokens, plan.slot_ids,
               plan.positions, plan.valid, plan.block_tables, last_idx,
               plan.num_valid > 0, plan.keys, plan.tok_index,
               plan.temperature, plan.top_k, plan.top_p)
+          self._note_step_specs(step_args)
+          out = self._step_fn(*step_args)
           if self._resilient:
             nxt, ok_dev, self._kv = out
             slot_ok = jax.device_get(ok_dev)
           else:
             nxt, self._kv = out
         else:
-          out = self._step_fn(
+          step_args = (
               self.params, self._kv, self._cursors, plan.tokens,
               plan.num_valid, plan.reset, plan.keys, plan.tok_index,
               plan.temperature, plan.top_k, plan.top_p)
+          self._note_step_specs(step_args)
+          out = self._step_fn(*step_args)
           if self._resilient:
             nxt, ok_dev, self._kv, self._cursors = out
             slot_ok = jax.device_get(ok_dev)
@@ -1223,6 +1282,31 @@ class ContinuousBatchingEngine:
     self._compile_sentinel.check(
         signature_fn=lambda: self._describe_signature(plan))
     dt = time.monotonic() - t0
+    # Device introspection runs BELOW the dt cut, like every other
+    # publish path: the warmup capture's AOT compile and the HBM
+    # gauges' per-device memory_stats host RPC must never inflate the
+    # step_time_s sample that feeds the ITL EWMA the admission ladder
+    # and SLO rules act on.
+    if self._pending_step_specs is not None:
+      # Warmup cost card (observability/device.py): introspect the twin
+      # through the AOT surface with the specs snapshotted above.  The
+      # jit call cache is untouched (the sentinel above stays silent —
+      # pinned) and no live buffer is read.
+      specs, self._pending_step_specs = self._pending_step_specs, None
+      self._introspector.capture_twin(
+          self._twin_label, self._step_fn, specs,
+          compile_count=self._compile_sentinel.cache_size() or 0,
+          meta=self._twin_meta())
+    if (self._introspector is not None
+        and (self._steps == 1
+             or self._steps % _STATS_PUBLISH_EVERY == 0)):
+      # HBM watermark gauges on the existing stats cadence (plus once
+      # right after warmup so short episodes still carry a sample):
+      # observability/device/* registry records + Perfetto counters;
+      # the SLO monitor sees them through the registry sink (or
+      # directly on registry-less engines).
+      self._introspector.publish_hbm(self._steps, registry=self.registry,
+                                     monitor=self._slo)
     # Throughput/ITL samples count COMMITTED tokens only: a bad slot's
     # planned tokens never committed and the identical work is re-fed
     # next step — counting both would double prefill/decode throughput

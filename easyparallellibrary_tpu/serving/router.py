@@ -223,33 +223,46 @@ class Router:
         heartbeat_s=self._heartbeat_s, itl_slo_s=self._itl_slo,
         clock=self.clock, on_transition=self._make_health_hook(index))
 
-  def add_replica(self) -> int:
-    """Grow the fleet by ONE replica built from the construction recipe
-    (same transport, config and engine kwargs as the originals);
-    returns its index.  On the process transport this is a REAL
-    subprocess spawn — the child builds its own engine and compiles its
-    own fused step once, exactly what a capacity add costs.  The parked
-    backlog flushes immediately: new capacity must serve, not idle.
+  @property
+  def spawn_recipe_available(self) -> bool:
+    """True when this router can BUILD new replicas (it constructed its
+    own fleet, so the recipe is on hand).  Injected-replica fleets
+    (tests) cannot grow — and the autoscaler's off-thread spawn path
+    keys off this to fall back to the synchronous lever."""
+    return self._replica_spec is not None
 
-    The autoscaler's cold scale-up path (serving/autoscale.py); also an
-    operator lever.  Raises on a fleet built from injected replicas
-    (tests) — there is no recipe to build from."""
+  def build_replica(self, index: Optional[int] = None):
+    """Construct ONE new replica from the stored recipe WITHOUT
+    registering it — the slow half of :meth:`add_replica` (a process
+    transport's subprocess spawn + in-child compile), split out so the
+    autoscaler can pay it on a background thread while the fleet keeps
+    sweeping (ROADMAP item 5 leftover).  The result is invisible to
+    routing until :meth:`adopt_replica` lands it on the router thread.
+
+    Thread-safety contract: this method only READS the recipe (and
+    spawns); it never touches the replica/health lists."""
     if self._replica_spec is None:
       raise RuntimeError(
-          "add_replica() needs a router that built its own replicas; "
+          "build_replica() needs a router that built its own replicas; "
           "a fleet constructed from injected replicas carries no "
           "(model, params)/factory recipe to grow from")
     spec = self._replica_spec
-    index = len(self.replicas)
+    index = len(self.replicas) if index is None else index
     if self.transport == "process":
-      rep: Any = ProcessTransport(
+      return ProcessTransport(
           index, spec["factory"], config=self._root_config,
           engine_kwargs=spec["engine_kwargs"])
-    else:
-      rep = InprocTransport(
-          index, spec["model"], spec["params"], mesh=spec["mesh"],
-          registry=spec["registry"], config=self._root_config,
-          **spec["engine_kwargs"])
+    return InprocTransport(
+        index, spec["model"], spec["params"], mesh=spec["mesh"],
+        registry=spec["registry"], config=self._root_config,
+        **spec["engine_kwargs"])
+
+  def adopt_replica(self, rep) -> int:
+    """Register a built replica with the fleet (the fast half of
+    :meth:`add_replica`): append to the replica/health lists, emit the
+    trace instant, flush the parked backlog.  MUST run on the router's
+    thread between sweeps — list mutation mid-sweep is never safe."""
+    index = len(self.replicas)
     self.replicas.append(rep)
     self.health.append(self._make_health(index))
     tracer = trace_lib.get_tracer()
@@ -262,6 +275,22 @@ class Router:
                       index, self.transport)
     self._flush_parked()
     return index
+
+  def add_replica(self) -> int:
+    """Grow the fleet by ONE replica built from the construction recipe
+    (same transport, config and engine kwargs as the originals);
+    returns its index.  On the process transport this is a REAL
+    subprocess spawn — the child builds its own engine and compiles its
+    own fused step once, exactly what a capacity add costs.  The parked
+    backlog flushes immediately: new capacity must serve, not idle.
+
+    The synchronous operator lever (blocks for the spawn).  The
+    autoscaler instead runs :meth:`build_replica` on a background
+    thread and :meth:`adopt_replica` at the next sweep, so a cold
+    scale-up never stalls the fleet (serving/autoscale.py).  Raises on
+    a fleet built from injected replicas (tests) — there is no recipe
+    to build from."""
+    return self.adopt_replica(self.build_replica())
 
   def _make_health_hook(self, index: int):
     def hook(old: str, new: str, reason: str):

@@ -173,6 +173,13 @@ class DraftModelDrafter(Drafter):
     # depend only on positions, which are identical on both sides, so
     # one host allocation serves both caches.
     self._paged = False
+    # Set at bind (observability/device.py cost-card capture).  The
+    # attempt flag is one-shot: a FAILED capture must not re-pay the
+    # AOT lower+compile on every subsequent propose() (capture_twin
+    # stores no card on failure — it logs once and degrades).
+    self._introspector = None
+    self._twin_label = "serving/drafter"
+    self._card_attempted = False
 
   @classmethod
   def from_checkpoint(cls, directory: str, model, *, k: int = 4,
@@ -207,8 +214,14 @@ class DraftModelDrafter(Drafter):
     return cls(model, params, k=k, mesh=mesh)
 
   def bind(self, engine):
+    from easyparallellibrary_tpu.observability import device as device_lib
     from easyparallellibrary_tpu.serving import kv_cache as kv_lib
     check_draft_compatible(engine.model.cfg, self.model.cfg)
+    # Device-truth introspection (observability/device.py): the draft
+    # rollout is a compiled twin like the fused step — its cost card is
+    # captured at the first propose() with that call's abstract specs.
+    self._introspector = device_lib.get_introspector()
+    self._twin_label = f"{engine._track_prefix}/drafter"
     mesh = self.mesh if self.mesh is not None else engine.mesh
     self._paged = bool(getattr(engine, "paged", False))
     if self._paged:
@@ -306,13 +319,26 @@ class DraftModelDrafter(Drafter):
                                      track="serving"):
       if self._paged:
         last_idx = (plan.base_idx + plan.num_valid - 1).astype(np.int32)
-        toks, self._kv = self._fn(
+        draft_args = (
             self.params, self._kv, plan.tokens, plan.slot_ids,
             plan.positions, plan.valid, plan.block_tables, last_idx,
             plan.draft_cap > 0)
       else:
-        toks, self._kv = self._fn(self.params, self._kv, self._cursors,
-                                  plan.tokens, plan.num_valid, plan.reset)
+        draft_args = (self.params, self._kv, self._cursors,
+                      plan.tokens, plan.num_valid, plan.reset)
+      if self._introspector is not None and not self._card_attempted:
+        from easyparallellibrary_tpu.observability import (
+            device as device_lib)
+        # Capture BEFORE the call: the cache buffer is donated, and the
+        # specs must describe arguments that still exist (abstract
+        # shapes only — nothing is read or transferred).  Exactly one
+        # attempt, success or not (the engine/fit captures follow the
+        # same one-shot rule).
+        self._card_attempted = True
+        self._introspector.capture_twin(
+            self._twin_label, self._fn, device_lib.specs_of(draft_args),
+            compile_count=1, meta={"k": self.k})
+      toks, self._kv = self._fn(*draft_args)
       # The drafter's one designated fetch — explicit, like the
       # engine's token fetch, so the serving loop stays legal under
       # jax.transfer_guard_device_to_host("disallow").

@@ -56,9 +56,14 @@ def _preserve_corrupt(path: str) -> None:
 
 
 def append_record(record: Dict[str, Any],
-                  path: Optional[str] = None) -> None:
-  """Append one measurement record; atomic-rename write so a crash
-  mid-dump cannot corrupt earlier evidence."""
+                  path: Optional[str] = None) -> Dict[str, Any]:
+  """Validate ``record`` against the evidence schema (below), then
+  append it; atomic-rename write so a crash mid-dump cannot corrupt
+  earlier evidence.  Raises ``ValueError`` listing every schema error —
+  the ONE door every writer (the benchmarks via ``benchmarks/
+  _evidence.py``, ``bench.py`` directly) goes through, so ``make
+  perf-gate`` (which refuses malformed records) can never meet a
+  ledger entry this process wrote and cannot trust."""
   path = path or evidence_path()
   _preserve_corrupt(path)
   records = load_records(path)
@@ -66,11 +71,18 @@ def append_record(record: Dict[str, Any],
   record.setdefault("unix_time", time.time())
   record.setdefault("utc", time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                          time.gmtime()))
+  errors = validate_record(record)
+  if errors:
+    raise ValueError(
+        f"malformed BENCH_EVIDENCE record for "
+        f"{record.get('metric')!r}: " + "; ".join(errors)
+        + " (schema: utils/bench_evidence.py validate_record)")
   records.append(record)
   tmp = path + ".tmp"
   with open(tmp, "w") as f:
     json.dump({"records": records}, f, indent=1)
   os.replace(tmp, path)
+  return record
 
 
 def latest_record(metric: str,
@@ -80,3 +92,56 @@ def latest_record(metric: str,
   if not matches:
     return None
   return max(matches, key=lambda r: r.get("unix_time", 0))
+
+
+# --------------------------------------------------------- record schema
+
+# Keys with fixed meaning; everything else in a record is metrics
+# payload.  A record's shape is name (``metric``) / ts (``unix_time`` +
+# ``utc``) / context (``config`` + the backend tags) / metrics (a
+# numeric ``value`` and/or payload keys) — the schema ``make perf-gate``
+# enforces before trusting a record (benchmarks/_evidence.py is the
+# shared writer that validates at write time).
+_NAME_KEY = "metric"
+_TS_KEYS = ("unix_time", "utc")
+_CONTEXT_KEYS = ("config", "backend", "device", "device_kind",
+                 "host_cores")
+_HEADLINE_KEYS = ("value", "unit")
+
+
+def validate_record(rec: Any) -> List[str]:
+  """Schema errors for one evidence record ([] = valid).
+
+  Required: a non-empty string ``metric`` (the name), a numeric
+  ``unix_time`` (the ts), and a metrics payload — either a numeric
+  ``value`` or at least one payload key beyond the name/ts/context/
+  headline sets.  ``config`` (the context), when present, must be an
+  object; ``value``, when present, must be numeric or null (null is the
+  honest "measurement unavailable" bench.py emits).  The perf gate
+  REFUSES malformed records instead of silently skipping them — an
+  unreadable ledger entry must fail loudly, not vanish from the
+  budget's view."""
+  if not isinstance(rec, dict):
+    return ["record is not a JSON object"]
+  errs: List[str] = []
+  name = rec.get(_NAME_KEY)
+  if not isinstance(name, str) or not name:
+    errs.append("missing/invalid 'metric' (the record's name)")
+  ts = rec.get("unix_time")
+  if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+    errs.append("missing/invalid 'unix_time' (the record's ts)")
+  ctx = rec.get("config")
+  if ctx is not None and not isinstance(ctx, dict):
+    errs.append("'config' (the record's context) must be an object")
+  value = rec.get("value")
+  if value is not None and (isinstance(value, bool)
+                            or not isinstance(value, (int, float))):
+    errs.append("'value' must be numeric or null")
+  reserved = set((_NAME_KEY,) + _TS_KEYS + _CONTEXT_KEYS + _HEADLINE_KEYS)
+  has_payload = (isinstance(value, (int, float))
+                 and not isinstance(value, bool)) or any(
+      k not in reserved for k in rec)
+  if not has_payload:
+    errs.append("no metrics payload: need a numeric 'value' or at "
+                "least one payload key")
+  return errs

@@ -45,6 +45,82 @@ def _by_rule(findings, rule):
   return [f for f in findings if f.rule == rule]
 
 
+# ------------------------------------------------- device-introspection
+
+
+def test_device_introspection_flags_hot_path_calls(tmp_path):
+  _write(tmp_path, "serving/eng.py", """\
+      import jax
+
+
+      class Engine:
+        def __init__(self):
+          self._step_fn = jax.jit(lambda x: x)
+
+        def step(self, plan):
+          compiled = self._step_fn.lower(plan).compile()
+          cost = compiled.cost_analysis()
+          return cost
+      """)
+  findings = _by_rule(_run(tmp_path), "device-introspection")
+  # Both the inline .lower() on the twin and the cost_analysis() read.
+  assert len(findings) == 2
+  assert {f.line for f in findings} == {9, 10}
+  assert all(f.path == "serving/eng.py" for f in findings)
+  assert any("cost_analysis" in f.message for f in findings)
+  assert any(".lower()" in f.message for f in findings)
+
+
+def test_device_introspection_flags_loops_and_memory_stats(tmp_path):
+  _write(tmp_path, "runtime/loop.py", """\
+      import jax
+
+
+      def fit(step_fn, state):
+        for dev in jax.local_devices():
+          stats = dev.memory_stats()
+        return state
+      """)
+  _write(tmp_path, "models/net.py", """\
+      import jax
+
+
+      def poll():
+        out = []
+        for dev in jax.local_devices():
+          out.append(dev.memory_stats())
+        return out
+      """)
+  findings = _by_rule(_run(tmp_path), "device-introspection")
+  assert {(f.path, f.line) for f in findings} == {
+      ("runtime/loop.py", 6), ("models/net.py", 7)}
+
+
+def test_device_introspection_allows_homes_and_warmup(tmp_path):
+  # observability/ and profiler/ are the introspection homes; a cold
+  # (non-hot, non-loop) call elsewhere is warmup tooling and legal.
+  _write(tmp_path, "observability/device.py", """\
+      def capture(fn, spec):
+        compiled = fn.lower(spec).compile()
+        return compiled.cost_analysis(), compiled.memory_analysis()
+      """)
+  _write(tmp_path, "profiler/flops.py", """\
+      import jax
+
+
+      def compiled_cost(fn, *args):
+        return jax.jit(fn).lower(*args).compile().cost_analysis()
+      """)
+  _write(tmp_path, "models/bench.py", """\
+      import jax
+
+
+      def warmup_probe(dev):
+        return dev.memory_stats()
+      """)
+  assert _by_rule(_run(tmp_path), "device-introspection") == []
+
+
 # ------------------------------------------------------------ host-sync
 
 

@@ -529,6 +529,80 @@ def test_autoscaler_flap_breaker_doubles_holdout():
   assert scaler.flap_trips == 0
 
 
+def _wait_spawn_outcome(scaler, timeout=5.0):
+  deadline = time.monotonic() + timeout
+  while time.monotonic() < deadline:
+    with scaler._lock:
+      if scaler._spawn_outcome is not None:
+        return True
+    time.sleep(0.005)
+  return False
+
+
+def test_autoscaler_cold_spawn_off_thread_slow_fake(monkeypatch):
+  """ROADMAP item 5 leftover closed: a cold scale-up spawn runs OFF the
+  synchronous sweep thread.  With a SLOW fake spawn in flight the sweep
+  keeps returning immediately, the new replica is unroutable until the
+  outcome is adopted at a later sweep, repeat grow impulses hold, and a
+  failing spawn books spawn_failures without ever counting a flap."""
+  import threading
+
+  clock = FakeClock()
+  router, scaler = _scaling_router(clock)
+  release = threading.Event()
+
+  def slow_build(index=None):
+    # The slow fake spawn: blocks until the test releases it — exactly
+    # the window a real subprocess spawn + in-child compile occupies.
+    assert release.wait(timeout=10.0)
+    return FakeReplica(len(router.replicas))
+
+  router.build_replica = slow_build
+  router._replica_spec = {}        # recipe "available": async path on
+  assert router.spawn_recipe_available
+  _burn_breach(scaler)
+  t0 = time.monotonic()
+  router.step()                    # starts the spawn, does NOT block
+  assert time.monotonic() - t0 < 1.0, "sweep blocked on the cold spawn"
+  assert scaler.spawn_in_flight
+  assert len(router.replicas) == 2, "replica routable before ready"
+  assert scaler.scale_ups == 0
+  # Repeat grow impulses during the in-flight spawn hold, never stack.
+  _burn_breach(scaler)
+  router.step()
+  assert scaler.scale_ups == 0 and scaler.holds >= 1
+  assert len(router.replicas) == 2
+  # Release the spawn; the outcome lands at the NEXT sweep boundary.
+  release.set()
+  assert _wait_spawn_outcome(scaler), "spawn outcome never posted"
+  assert len(router.replicas) == 2, "adoption must wait for the sweep"
+  router.step()
+  assert scaler.scale_ups == 1 and len(router.replicas) == 3
+  assert not scaler.spawn_in_flight
+  assert 2 in scaler._added
+  assert router.states() == ["healthy", "healthy", "healthy"]
+  assert scaler.flap_trips == 0
+  # Failure half: a raising spawn is booked and cooled down, and is
+  # NEVER a flap even right after a scale-down (no grow landed).
+  clock.advance(100.0)
+  router.step()                    # quiet -> drains replica 2
+  assert scaler.scale_downs == 1
+
+  def bad_build(index=None):
+    raise RuntimeError("fake spawn failure")
+
+  router.build_replica = bad_build
+  scaler._parked = []              # force the cold-spawn path, not rejoin
+  clock.advance(2.0)               # inside flap_window_s of the drain
+  _burn_breach(scaler)
+  router.step()                    # starts (and fails) the spawn
+  assert _wait_spawn_outcome(scaler), "failure outcome never posted"
+  router.step()                    # books the failure
+  assert scaler.spawn_failures == 1
+  assert scaler.flap_trips == 0, "a failed spawn must not count a flap"
+  assert scaler.scale_ups == 1 and len(router.replicas) == 3
+
+
 # --------------------------------------- quick: fault-free equivalence
 
 
@@ -668,7 +742,8 @@ def test_overload_burst_heals_scales_and_drains_back(tmp_path):
   post_prompts = _prompts(6, seed=11)   # fresh: no prefix affinity,
   deadline = time.monotonic() + 120.0   # so least-loaded wins and the
   scaler = router._autoscaler           # idle new replica is chosen
-  while router.has_work and time.monotonic() < deadline:
+  filler_uid = 500
+  while time.monotonic() < deadline:
     router.step()
     if scaler.scale_ups >= 1 and not post and router.has_work:
       for k in range(6):
@@ -677,6 +752,21 @@ def test_overload_burst_heals_scales_and_drains_back(tmp_path):
                                  max_new_tokens=max_new)):
           post.append(uid)
           post_placed.append(router.placement.get(uid))
+    if not router.has_work:
+      if post or (not scaler.spawn_in_flight
+                  and scaler.scale_ups == 0):
+        break
+      # The cold spawn now runs OFF the sweep thread (ROADMAP item 5
+      # leftover closed): the backlog can drain before the child is
+      # ready, so keep light pressure on the survivors until adoption
+      # lands AND the post wave is submitted — the post wave must meet
+      # a loaded fleet with one idle fresh replica, which is the
+      # scenario being pinned.
+      if router.submit(Request(uid=filler_uid,
+                               prompt=prompts[(filler_uid - 500) % 20],
+                               max_new_tokens=max_new)):
+        accepted.append(filler_uid)
+      filler_uid += 1
   assert scaler.scale_ups >= 1, "no scale-up fired"
   assert len(router.replicas) == 3
   spawned = router.replicas[2]
@@ -712,7 +802,12 @@ def test_overload_burst_heals_scales_and_drains_back(tmp_path):
     if fin.finish_reason == "shed":  # replica-side admission shed
       continue
     assert fin.finish_reason == "length"
-    prompt = prompts[u] if u < 100 else post_prompts[u - 100]
+    if u >= 500:                        # spawn-window filler traffic
+      prompt = prompts[(u - 500) % 20]
+    elif u >= 100:
+      prompt = post_prompts[u - 100]
+    else:
+      prompt = prompts[u]
     np.testing.assert_array_equal(
         fin.tokens, _oracle(model, params, prompt, max_new),
         err_msg=f"req {u}")
