@@ -87,6 +87,13 @@ serve-bench:
 paged-bench:
 	python benchmarks/decode_throughput.py --paged
 
+# Warm vs cold TTFT with copy-on-write prefix caching: Zipf-shared
+# templates under Poisson arrivals + a multi-turn chat trace
+# (benchmarks/prefix_cache.py -> BENCH_EVIDENCE.json; docs/serving.md
+# "Prefix caching").
+prefix-bench:
+	python benchmarks/prefix_cache.py
+
 # Speculative vs plain decode on repetitive/incompressible traces
 # (benchmarks/speculative_decode.py -> BENCH_EVIDENCE.json; docs/serving.md).
 spec-bench:
@@ -147,6 +154,7 @@ help:
 	@echo "  heal-bench     - actuators-on vs frozen fleet under the overload burst"
 	@echo "  serve-bench    - continuous batching vs static generate()"
 	@echo "  paged-bench    - paged vs contiguous KV cache (long-tail trace)"
+	@echo "  prefix-bench   - warm vs cold TTFT with prefix caching (Zipf + chat traces)"
 	@echo "  spec-bench     - speculative vs plain decode"
 	@echo "  overload-bench - admission control under Poisson overload"
 	@echo "  router-bench   - replica-kill failover episode (0 lost requests)"
@@ -158,4 +166,4 @@ help:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test lint perf-gate gate bench chaos chaos-serve chaos-router chaos-proc chaos-heal serve-bench paged-bench spec-bench overload-bench router-bench heal-bench trace-demo obs-bench help clean
+.PHONY: all build test lint perf-gate gate bench chaos chaos-serve chaos-router chaos-proc chaos-heal serve-bench paged-bench prefix-bench spec-bench overload-bench router-bench heal-bench trace-demo obs-bench help clean

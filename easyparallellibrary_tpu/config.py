@@ -442,6 +442,25 @@ class ServingConfig(_Category):
       # batch cap); prefill chunks and speculative drafts share the
       # rest.
       "paged.token_budget": 0,
+      # --- copy-on-write prefix caching over the paged pool
+      # (serving/prefix_cache.py, docs/serving.md "Prefix caching").
+      # Requires paged.enabled: admission walks a content-addressed
+      # radix tree over full prompt blocks, maps matched blocks by
+      # reference (refcount++, no device copy) and prefills only the
+      # unmatched tail; retired requests' blocks stay pinned in the
+      # tree so multi-turn follow-ups admit warm.  Off by default: with
+      # it on, cached blocks keep kv_blocks_used nonzero between
+      # requests by design.
+      "prefix_cache.enabled": False,
+      # Seconds an unused cached entry survives before the per-step
+      # expiry sweep drops it (session persistence horizon).  0 = no
+      # TTL: entries live until LRU/space eviction reclaims them.
+      "prefix_cache.session_ttl_s": 0.0,
+      # Cap on tree-resident blocks; beyond it the least-recent entries
+      # are shed regardless of sharing.  0 = uncapped (the pool itself
+      # still bounds residency: a dry pool evicts unmapped cached
+      # blocks before preempting any live slot).
+      "prefix_cache.max_cached_blocks": 0,
       # --- speculative decoding (serving/speculative/, docs/serving.md).
       # Draft k tokens per decode slot and verify them in the SAME fused
       # step (the drafts ride chunk positions plain decode wastes), so
@@ -586,6 +605,10 @@ class ServingConfig(_Category):
   @property
   def paged(self) -> _SubGroup:
     return _SubGroup(self, "paged")
+
+  @property
+  def prefix_cache(self) -> _SubGroup:
+    return _SubGroup(self, "prefix_cache")
 
   @property
   def resilience(self) -> _SubGroup:
@@ -872,6 +895,20 @@ class Config:
     if paged.token_budget < 0:
       raise ValueError(f"serving.paged.token_budget must be >= 0 (0 = "
                        f"auto); got {paged.token_budget}")
+    pcache = self.serving.prefix_cache
+    if pcache.enabled and not paged.enabled:
+      raise ValueError(
+          "serving.prefix_cache.enabled requires serving.paged.enabled: "
+          "prefix caching shares KV at the paged layout's block "
+          "granularity (engine kwargs can still combine paged=True with "
+          "prefix_cache=True explicitly)")
+    if pcache.session_ttl_s < 0:
+      raise ValueError(f"serving.prefix_cache.session_ttl_s must be >= 0 "
+                       f"(0 = no TTL); got {pcache.session_ttl_s}")
+    if pcache.max_cached_blocks < 0:
+      raise ValueError(f"serving.prefix_cache.max_cached_blocks must be "
+                       f">= 0 (0 = uncapped); "
+                       f"got {pcache.max_cached_blocks}")
     spec = self.serving.speculative
     if spec.k < 1:
       raise ValueError(

@@ -162,6 +162,10 @@ def request_timelines(events: List[Dict[str, Any]]
         "decode_us": phase_us["decode"] + phase_us["speculate"],
         "drafted": drafted, "accepted": accepted,
         "kv_blocks_peak": kv_blocks_peak,
+        # Blocks mapped by reference from the prefix cache at admission
+        # (scheduler._admit stamps the request span).  0 without the
+        # cache — the column stays hidden below.
+        "blk_reused": req["args"].get("prefix_blocks_reused", 0),
         "new_tokens": req["args"].get("new_tokens"),
         "finish_reason": req["args"].get("finish_reason"),
         "requeues": requeues.get(uid, 0),
@@ -183,6 +187,7 @@ def request_timelines(events: List[Dict[str, Any]]
         "prefill_us": 0.0, "prefill_chunks": 0,
         "decode_steps": 0, "decode_us": 0.0,
         "drafted": 0, "accepted": 0, "kv_blocks_peak": 0,
+        "blk_reused": 0,
         "new_tokens": None, "finish_reason": reason,
         "requeues": requeues.get(uid, 0),
     })
@@ -442,10 +447,14 @@ def format_report(events: List[Dict[str, Any]]) -> str:
     # The blk column (peak KV blocks held) only appears when any request
     # actually ran paged — a contiguous-engine trace keeps its old shape.
     paged = any(r["kv_blocks_peak"] for r in requests)
+    # Same shape-preservation rule for blk-reused: it only appears when
+    # the prefix cache actually mapped shared blocks into some request.
+    reuse = any(r["blk_reused"] for r in requests)
     lines.append(f"{'request':<12}{'wait':>9}{'ttft':>10}{'prefill':>10}"
                  f"{'chunks':>7}{'decode':>10}{'steps':>6}{'drafted':>8}"
                  f"{'accepted':>9}{'rq':>4}"
                  + (f"{'blk':>5}" if paged else "")
+                 + (f"{'blk-reused':>11}" if reuse else "")
                  + f"{'total':>10}  finish")
     for r in requests:
       lines.append(
@@ -455,6 +464,7 @@ def format_report(events: List[Dict[str, Any]]) -> str:
           f"{r['decode_steps']:>6}{r['drafted']:>8}{r['accepted']:>9}"
           f"{r['requeues']:>4}"
           + (f"{r['kv_blocks_peak']:>5}" if paged else "")
+          + (f"{r['blk_reused']:>11}" if reuse else "")
           + f"{_fmt_us(r['total_us']):>10}"
           f"  {r['finish_reason'] or '-'}")
   counters = sorted({e["name"] for e in events if e.get("ph") == "C"})

@@ -181,6 +181,15 @@ class ServingStats:
     self.kv_fragmentation = 0.0
     self.preemptions = 0
     self.proactive_preemptions = 0
+    # Prefix-cache counters (all 0 without serving.prefix_cache):
+    # cumulative admission hits/misses, total blocks mapped by
+    # reference instead of prefilled, tree evictions, and the tree's
+    # current resident footprint (docs/serving.md "Prefix caching").
+    self.prefix_hits = 0
+    self.prefix_misses = 0
+    self.prefix_blocks_reused = 0
+    self.prefix_evictions = 0
+    self.prefix_cached_blocks = 0
     # Live ITL estimate: EWMA of decode-step wall time (module
     # docstring).  0.0 until the SECOND decoding step — the first
     # decode-step sample can carry one-time XLA compile work (a draft
@@ -266,6 +275,18 @@ class ServingStats:
     self.preemptions = int(preemptions)
     self.proactive_preemptions = int(proactive_preemptions)
 
+  def note_prefix(self, hits: int, misses: int, blocks_reused: int,
+                  evictions: int, cached_blocks: int = 0):
+    """Prefix-cache counters, fed per step by a prefix-caching paged
+    engine (serving/prefix_cache.py).  Same last-write-wins discipline
+    as :meth:`note_blocks`: the scheduler's radix tree accumulates the
+    totals; ``cached_blocks`` is a level (current tree footprint)."""
+    self.prefix_hits = int(hits)
+    self.prefix_misses = int(misses)
+    self.prefix_blocks_reused = int(blocks_reused)
+    self.prefix_evictions = int(evictions)
+    self.prefix_cached_blocks = int(cached_blocks)
+
   def note_degraded(self, level: int):
     self.degraded_transitions += 1
     self.degraded_level = int(level)
@@ -347,7 +368,9 @@ class ServingStats:
       "step_retries", "degraded_transitions", "degraded_level",
       "watchdog_timeouts", "recompiles", "kv_blocks_free",
       "kv_blocks_used", "kv_fragmentation", "preemptions",
-      "proactive_preemptions", "itl_ewma_s")
+      "proactive_preemptions", "prefix_hits", "prefix_misses",
+      "prefix_blocks_reused", "prefix_evictions",
+      "prefix_cached_blocks", "itl_ewma_s")
 
   def state_dict(self) -> Dict[str, Any]:
     """JSON-serializable rollup state: every aggregate counter plus the
@@ -419,6 +442,17 @@ class ServingStats:
         "kv_fragmentation": float(self.kv_fragmentation),
         "preemptions": float(self.preemptions),
         "proactive_preemptions": float(self.proactive_preemptions),
+        # Prefix cache (all 0.0 without serving.prefix_cache; docs/
+        # serving.md "Prefix caching").  Hit rate is per ADMISSION, not
+        # per block — the signal an operator tunes TTL/budget against.
+        "prefix_hits": float(self.prefix_hits),
+        "prefix_misses": float(self.prefix_misses),
+        "prefix_blocks_reused": float(self.prefix_blocks_reused),
+        "prefix_evictions": float(self.prefix_evictions),
+        "prefix_cached_blocks": float(self.prefix_cached_blocks),
+        "prefix_hit_rate": (
+            self.prefix_hits / (self.prefix_hits + self.prefix_misses)
+            if (self.prefix_hits + self.prefix_misses) else 0.0),
         # Resilience (all 0.0 on a non-resilient engine; docs/
         # robustness.md "Serving resilience").
         "shed": float(self.shed_requests),
@@ -499,6 +533,17 @@ def fleet_summary(replica_stats: List["ServingStats"],
       "preemptions": float(sum(s.preemptions for s in stats)),
       "proactive_preemptions": float(
           sum(s.proactive_preemptions for s in stats)),
+      # Prefix cache: counters sum; the fleet hit rate re-derives from
+      # the summed counters (a mean of per-replica rates would weight
+      # an idle replica equally with a loaded one).
+      "prefix_hits": float(sum(s.prefix_hits for s in stats)),
+      "prefix_misses": float(sum(s.prefix_misses for s in stats)),
+      "prefix_blocks_reused": float(
+          sum(s.prefix_blocks_reused for s in stats)),
+      "prefix_evictions": float(sum(s.prefix_evictions for s in stats)),
+      "prefix_hit_rate": (
+          sum(s.prefix_hits for s in stats)
+          / max(1, sum(s.prefix_hits + s.prefix_misses for s in stats))),
       "degraded": float(sum(s.degraded_transitions for s in stats)),
       "watchdog_timeouts": float(
           sum(s.watchdog_timeouts for s in stats)),

@@ -11,7 +11,10 @@ control with overload shedding, per-request deadlines/cancellation,
 and bad-step retry/quarantine (:mod:`resilience`) — plus a replicated
 control plane: N engine replicas (:mod:`replica`) behind a
 health-checked :class:`Router` with bit-exact failover, graceful
-drain/rejoin and prefix-affinity dispatch (:mod:`router`).  See
+drain/rejoin and prefix-affinity dispatch (:mod:`router`) — and
+fleet-wide copy-on-write prefix caching: a content-addressed radix tree
+over prompt blocks that maps shared KV by reference at admission and
+persists session prefixes across requests (:mod:`prefix_cache`).  See
 docs/serving.md and docs/robustness.md.
 """
 
@@ -40,6 +43,9 @@ from easyparallellibrary_tpu.serving.kv_cache import (
     allocate_paged_kv_cache, blocks_per_slot, cache_bytes, cache_length,
     default_num_blocks, kv_cache_shardings, paged_cache_bytes,
 )
+from easyparallellibrary_tpu.serving.prefix_cache import (
+    PrefixCache, block_prefix_keys,
+)
 from easyparallellibrary_tpu.serving.scheduler import (
     FCFSScheduler, FinishedRequest, PagedStepPlan, Request, StepPlan,
 )
@@ -56,6 +62,7 @@ __all__ = [
     "blocks_per_slot", "default_num_blocks", "paged_cache_bytes",
     "FCFSScheduler", "FinishedRequest", "PagedStepPlan", "Request",
     "StepPlan",
+    "PrefixCache", "block_prefix_keys",
     "check_draft_compatible", "check_servable",
     "AdmissionController", "BadStepPolicy", "DEGRADE_LEVELS",
     "FINISH_REASONS", "PRIORITIES",

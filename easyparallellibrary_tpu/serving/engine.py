@@ -207,6 +207,7 @@ class ContinuousBatchingEngine:
                block_size: Optional[int] = None,
                num_blocks: Optional[int] = None,
                token_budget: Optional[int] = None,
+               prefix_cache: Optional[bool] = None,
                stats=None, metrics_writer=None, registry=None,
                config=None, track_prefix: Optional[str] = None):
     cfg = model.cfg
@@ -281,6 +282,12 @@ class ContinuousBatchingEngine:
     else:
       self.block_size = self.num_blocks = self.token_budget = 0
       self._paged_impl = None
+    # Copy-on-write prefix caching (serving.prefix_cache.*;
+    # docs/serving.md "Prefix caching"): radix-tree block reuse over
+    # the paged pool — the scheduler rejects it without paged mode.
+    pc_conf = conf.prefix_cache
+    self.prefix_caching = (prefix_cache if prefix_cache is not None
+                           else pc_conf.enabled)
     self.drafter = self._resolve_drafter(conf, drafter, speculative,
                                          draft_model, draft_params)
     self.scheduler = FCFSScheduler(
@@ -292,7 +299,10 @@ class ContinuousBatchingEngine:
         spec_k=self.drafter.k if self.drafter is not None else 0,
         block_size=self.block_size, num_blocks=self.num_blocks,
         token_budget=self.token_budget,
-        track_prefix=self._track_prefix)
+        track_prefix=self._track_prefix,
+        prefix_cache=self.prefix_caching,
+        prefix_session_ttl_s=pc_conf.session_ttl_s,
+        prefix_max_cached_blocks=pc_conf.max_cached_blocks)
     res_conf = conf.resilience
     self._resilient = (resilience if resilience is not None
                        else res_conf.enabled)
@@ -583,6 +593,12 @@ class ContinuousBatchingEngine:
                  kv_fragmentation=sched.kv_fragmentation,
                  preemptions=sched.preemptions,
                  proactive_preemptions=sched.proactive_preemptions)
+      if self.prefix_caching:
+        ctx.update(prefix_hits=sched.prefix_hits,
+                   prefix_misses=sched.prefix_misses,
+                   prefix_blocks_reused=sched.prefix_blocks_reused,
+                   prefix_evictions=sched.prefix_evictions,
+                   prefix_cached_blocks=sched.prefix_cached_blocks)
     out = {self._track_prefix: ctx}
     if self._introspector is not None:
       # Device truth rides every diagnostic bundle: cost cards, live
@@ -1073,17 +1089,24 @@ class ContinuousBatchingEngine:
     slot_starts: Dict[int, int] = {}
     cursors = None
     for slot, action in actions.items():
+      freed = action in (BadStepPolicy.REQUEUE, BadStepPolicy.FAIL)
       if action == BadStepPolicy.REQUEUE:
         self.scheduler.requeue_slot(slot, reason="bad_step")
-        slot_starts[slot] = 0
       elif action == BadStepPolicy.FAIL:
         self.scheduler.retire_slot(slot, "failed")
-        slot_starts[slot] = 0
-      elif self.paged:
-        # RETRY: the plan's first scheduled position for the slot is the
-        # committed watermark — no device fetch needed (positions are
-        # host-planned in the paged layout).
+      if self.paged:
+        # Paged: zero from the committed watermark up, freed or not.
+        # The plan's first scheduled position for the slot IS the
+        # watermark — no device fetch needed (positions are
+        # host-planned in the paged layout) — and every one of the bad
+        # step's writes landed at a scheduled position at or above it.
+        # Rows below hold real committed K/V; with prefix sharing live
+        # a released prefix block may still be mapped by the radix
+        # tree or a sibling slot's table, so zeroing below the
+        # watermark would corrupt a HEALTHY request's cache.
         slot_starts[slot] = int(plan.positions[plan.base_idx[slot]])
+      elif freed:
+        slot_starts[slot] = 0
       else:  # RETRY: zero the bad step's uncommitted writes only.
         if cursors is None:  # host sync on the rare bad-step path only
           cursors = jax.device_get(self._cursors)
@@ -1129,10 +1152,19 @@ class ContinuousBatchingEngine:
         if (j + 1) * bs <= pos:
           continue  # wholly below the committed watermark: rows are real
         row = max(0, pos - j * bs)
-        # A block may appear twice transiently (refcounted sharing later,
-        # ROADMAP item 2): keep the LOWEST start — zeroing more is safe.
+        # A block CAN appear twice now that prefix sharing is real
+        # (serving/prefix_cache.py) — but only a shared PREFIX block,
+        # which sits wholly below every sharer's watermark and is
+        # skipped above.  Two bad slots listing one block therefore
+        # agree it needs zeroing; keep the LOWEST start defensively.
         start[blk] = row if not mask[blk] else min(start[blk], row)
         mask[blk] = True
+    # Zeroed content must never satisfy a future prefix match.  Purely
+    # defensive — registration is commit-gated, so a masked
+    # (above-watermark) block is never in the tree — but the purge is
+    # cheap and makes the invariant unconditional.
+    self.scheduler.invalidate_cached_blocks(
+        int(b) for b in np.nonzero(mask)[0] if b != kv_lib.NULL_BLOCK)
     self._kv = self._sanitize_fn(self._kv, mask, start)
 
   def step(self) -> List[FinishedRequest]:
@@ -1326,6 +1358,19 @@ class ContinuousBatchingEngine:
                        self.scheduler.kv_blocks_used)
         tracer.counter("serving/kv_blocks_free",
                        self.scheduler.kv_blocks_free)
+        if self.prefix_caching:
+          # Prefix-cache effectiveness next to pool pressure: hit/miss
+          # and reuse counters plus the tree's resident footprint.
+          tracer.counter("serving/prefix_hits",
+                         self.scheduler.prefix_hits)
+          tracer.counter("serving/prefix_misses",
+                         self.scheduler.prefix_misses)
+          tracer.counter("serving/prefix_blocks_reused",
+                         self.scheduler.prefix_blocks_reused)
+          tracer.counter("serving/prefix_evictions",
+                         self.scheduler.prefix_evictions)
+          tracer.counter("serving/prefix_cached_blocks",
+                         self.scheduler.prefix_cached_blocks)
       if drafted:
         tracer.counter("serving/drafted_tokens", drafted)
         tracer.counter("serving/accepted_tokens", accepted)
@@ -1341,6 +1386,12 @@ class ContinuousBatchingEngine:
                                self.scheduler.kv_fragmentation,
                                self.scheduler.preemptions,
                                self.scheduler.proactive_preemptions)
+        if self.prefix_caching:
+          self.stats.note_prefix(self.scheduler.prefix_hits,
+                                 self.scheduler.prefix_misses,
+                                 self.scheduler.prefix_blocks_reused,
+                                 self.scheduler.prefix_evictions,
+                                 self.scheduler.prefix_cached_blocks)
     if (self.metrics_writer is not None or self.registry is not None
         or self._slo is not None):
       record = {
@@ -1360,6 +1411,16 @@ class ContinuousBatchingEngine:
         record["preemptions"] = self.scheduler.preemptions
         record["proactive_preemptions"] = (
             self.scheduler.proactive_preemptions)
+        if self.prefix_caching:
+          # Prefix-cache counters under the same serving/* schema
+          # (cumulative, like preemptions).
+          record["prefix_hits"] = self.scheduler.prefix_hits
+          record["prefix_misses"] = self.scheduler.prefix_misses
+          record["prefix_blocks_reused"] = (
+              self.scheduler.prefix_blocks_reused)
+          record["prefix_evictions"] = self.scheduler.prefix_evictions
+          record["prefix_cached_blocks"] = (
+              self.scheduler.prefix_cached_blocks)
       if self.drafter is not None:
         record["drafted_tokens"] = drafted
         record["accepted_tokens"] = accepted

@@ -223,12 +223,20 @@ class BlockAllocator:
   """Host-side free-list + refcounts over the paged K/V pool.
 
   Lowest-free-first (a heap) keeps block assignment deterministic for a
-  given request order, mirroring :class:`SlotAllocator`.  Refcounts are
-  carried NOW — every block currently holds exactly one reference — so
-  copy-on-write prefix sharing (ROADMAP item 2) can later share a block
-  between slots by increffing instead of copying; ``decref`` returns the
-  block to the free list only at zero.  Block ``NULL_BLOCK`` is reserved
-  and never allocated.
+  given request order, mirroring :class:`SlotAllocator`.  Refcounts
+  carry the copy-on-write prefix sharing that
+  ``serving/prefix_cache.py`` builds on this pool: a block starts at
+  refcount 1 (its allocating slot), the radix tree adds one reference
+  when it registers the block's content, and every slot that maps the
+  block through a prefix match adds another — so a block's count is
+  ``owning slot + tree entry + sharers``, and ``decref`` returns it to
+  the free list only when the LAST holder lets go.  Shared blocks are
+  read-only by construction (matching stops strictly before the first
+  divergent/partial block; writes always land past the shared region —
+  prefix_cache.py's COW rule), so sharing needs no device copy.  Block
+  ``NULL_BLOCK`` is reserved, never allocated and NEVER shared: its
+  rows are garbage by design (trash writes land there), so the tree
+  refuses to register it.
   """
 
   def __init__(self, num_blocks: int, block_size: int):
@@ -260,7 +268,8 @@ class BlockAllocator:
     return blk
 
   def incref(self, block: int) -> None:
-    """Add a reference (future copy-on-write sharing: ROADMAP item 2)."""
+    """Add a reference (prefix-cache tree entries and COW prefix
+    sharers: serving/prefix_cache.py)."""
     if block not in self._ref:
       raise ValueError(f"block {block} is not allocated")
     self._ref[block] += 1

@@ -1,0 +1,341 @@
+"""Copy-on-write prefix caching over the paged KV pool — the radix
+tree that turns shared prompt prefixes into shared blocks.
+
+Heavy real traffic is dominated by shared prefixes (system prompts,
+few-shot templates, multi-turn chats replaying their whole history every
+turn), yet a cold admission pays full prefill for all of it.  The paged
+cache (serving/kv_cache.py) already exposes the seam this needs: K/V
+lives in fixed-size blocks behind per-slot block tables, and a block
+whose covering token prefix matches is BIT-IDENTICAL between requests —
+K/V content at position p depends only on the params and tokens
+``0..p`` — so it can be shared by reference instead of recomputed.
+
+:class:`PrefixCache` is a content-addressed radix tree over full prompt
+blocks.  Each node holds one pool block plus the exact token bytes that
+filled it; a node's path from the root spells the block-aligned token
+prefix whose K/V the block carries.  On admission the scheduler walks
+the tree with the request's prefix (:meth:`match`): every matched block
+maps into the request's block table by reference (``refcount++`` — no
+device copy, no extra compiled program), the prompt cursor jumps past
+the matched region, and chunked prefill runs ONLY for the unmatched
+tail.  A fully warm prefix therefore skips prefill entirely but for the
+final partial block, and TTFT collapses toward a single fused step.
+
+Copy-on-write discipline — why shared blocks are never written:
+matching is capped at ``(len(prefix) - 1) // block_size`` FULL blocks,
+i.e. strictly before the last prompt token.  The divergent or
+partially-filled block is always freshly allocated and rebuilt by
+normal chunked prefill (COW-by-recompute: recomputing up to one block
+is cheaper than a device-side block copy and keeps the fused step —
+and its compile count of 1 — untouched).  Prefill writes then start at
+``prompt_pos = matched * block_size`` and decode writes at positions
+``>= len(prompt)``, both strictly past every shared block, so a shared
+mapping is read-only by construction.  ``NULL_BLOCK`` is never
+registered: it is the pool's trash row and its content is garbage by
+design (kv_cache.py).
+
+Session persistence: the tree holds its OWN reference on every block it
+registers, so a retired request's blocks stay resident after its slot
+releases them — turn N+1 of a chat replays its history against warm
+blocks.  Residency is bounded two ways: ``session_ttl_s`` expires
+entries not touched within the TTL, and ``max_cached_blocks`` caps the
+tree's total footprint (LRU beyond it).  Eviction integrates with the
+scheduler's exhaustion path (scheduler.py ``_ensure_blocks``):
+cached-but-unmapped blocks (tree refcount is the last reference) are
+reclaimed via :meth:`evict_for_space` BEFORE any live slot is
+preempted, so warm cache never costs a running request its progress.
+
+LRU invariant: every lookup/registration touches its whole root→node
+path, deepest node first, so an ancestor is always at least as recent
+as its descendants and the LRU front is always a leaf — eviction pops
+leaves without tree surgery, and one front-to-back sweep unwinds whole
+chains (a parent freed of its last child appears later in the same
+sweep, being newer).
+
+The router shares this module's content hashing
+(:func:`block_prefix_keys`) so fleet dispatch and local block reuse
+agree on what "same prefix" means — a warm prefix routes to the replica
+already holding its blocks (docs/serving.md "Prefix caching").
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from collections import OrderedDict
+from typing import Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from easyparallellibrary_tpu.serving.kv_cache import (
+    BlockAllocator, NULL_BLOCK)
+
+# Router affinity probes at most this many block-aligned prefix depths
+# (deepest first).  A cap keeps the per-submit work and the affinity
+# LRU's key fan-out bounded on very long prompts; eight blocks of
+# shared prefix is already far past where affinity routing stops
+# mattering (the replica either has the template or it does not).
+AFFINITY_MAX_BLOCKS = 8
+
+# Distinct crc32 chain seeds so a block-aligned key can never collide
+# with a short-prompt fallback key of identical bytes.
+_BLOCK_SALT = zlib.crc32(b"epl/prefix/block")
+_SHORT_SALT = zlib.crc32(b"epl/prefix/short")
+
+
+def block_prefix_keys(prompt, block_size: int,
+                      max_blocks: int = AFFINITY_MAX_BLOCKS) -> List[int]:
+  """Content keys for every block-aligned prefix depth of ``prompt``,
+  shallowest first — the SHARED hashing between the radix tree's block
+  granularity and the router's affinity map (router.py).
+
+  ``keys[d-1]`` covers tokens ``[0, d * block_size)``; each key chains
+  the previous depth's crc32 (incremental — hashing all depths costs
+  one pass over the prefix).  Only FULL blocks strictly before the last
+  token get a key, mirroring :meth:`PrefixCache.match`'s cap: a depth
+  the tree can never match is a depth not worth routing on.  A prompt
+  too short for any full block falls back to one whole-prompt key under
+  a distinct salt, preserving exact-duplicate affinity for tiny
+  prompts.  Deterministic and process-stable (crc32, not Python's
+  salted ``hash``), like every other cross-replica key in serving/.
+  """
+  prompt = np.ascontiguousarray(np.asarray(prompt, np.int32).reshape(-1))
+  full = max(0, int(prompt.size) - 1) // block_size if block_size > 0 else 0
+  keys: List[int] = []
+  crc = _BLOCK_SALT
+  for d in range(min(full, max_blocks)):
+    crc = zlib.crc32(prompt[d * block_size:(d + 1) * block_size].tobytes(),
+                     crc)
+    keys.append(crc)
+  if not keys:
+    keys.append(zlib.crc32(prompt.tobytes(), _SHORT_SALT))
+  return keys
+
+
+class _Node:
+  """One cached block: the exact token bytes that filled it, the pool
+  block carrying their K/V, and its place in the tree."""
+
+  __slots__ = ("key", "block", "parent", "children", "last_touch")
+
+  def __init__(self, key: bytes, block: int, parent: "_Node",
+               now: float):
+    self.key = key
+    self.block = block
+    self.parent = parent
+    self.children: Dict[bytes, "_Node"] = {}
+    self.last_touch = now
+
+
+class PrefixCache:
+  """Content-addressed radix tree over prompt blocks (module docstring).
+
+  Children are keyed by the block's EXACT token bytes (no hash, no
+  collisions): a match is a byte-equality walk, so a mapped block is
+  guaranteed to carry the K/V of precisely the tokens being admitted —
+  the bit-exactness contract needs nothing weaker.  The tree owns one
+  allocator reference per registered block (dropped on eviction /
+  expiry / invalidation); mapping a match into a slot adds the slot's
+  own reference on top, so a block is never freed while any table still
+  points at it.
+
+  Counters (``hits``/``misses``/``blocks_reused``/``evictions``) are
+  cumulative and feed ``ServingStats`` + the ``serving/prefix_*``
+  counter tracks (profiler/serving.py, engine.py).
+  """
+
+  def __init__(self, allocator: BlockAllocator, block_size: int,
+               session_ttl_s: float = 0.0, max_cached_blocks: int = 0,
+               clock: Callable[[], float] = time.monotonic):
+    if block_size < 1:
+      raise ValueError(f"block_size must be >= 1: {block_size}")
+    if session_ttl_s < 0:
+      raise ValueError(f"session_ttl_s must be >= 0: {session_ttl_s}")
+    if max_cached_blocks < 0:
+      raise ValueError(
+          f"max_cached_blocks must be >= 0: {max_cached_blocks}")
+    self.allocator = allocator
+    self.block_size = block_size
+    self.session_ttl_s = session_ttl_s
+    self.max_cached_blocks = max_cached_blocks
+    self.clock = clock
+    self._root = _Node(b"", NULL_BLOCK, None, 0.0)  # sentinel, no block
+    # Insertion/touch-ordered node registry: front = least recent.  The
+    # deepest-first path-touch discipline (module docstring) keeps the
+    # front a leaf, so LRU eviction never needs tree surgery.
+    self._lru: "OrderedDict[_Node, None]" = OrderedDict()
+    self.hits = 0
+    self.misses = 0
+    self.blocks_reused = 0
+    self.evictions = 0
+
+  @property
+  def num_cached_blocks(self) -> int:
+    return len(self._lru)
+
+  def _touch_path(self, path: List[_Node], now: float) -> None:
+    # Deepest first, so every ancestor ends NEWER than its descendants
+    # (the leaf-at-LRU-front invariant).
+    for node in reversed(path):
+      node.last_touch = now
+      self._lru.move_to_end(node)
+
+  def _remove_subtree(self, node: _Node) -> int:
+    """Drop ``node`` and every descendant, releasing the tree's block
+    references.  Descendants are unlinked too (not re-rooted): their
+    content is only addressable through this path."""
+    stack, order = [node], []
+    while stack:
+      n = stack.pop()
+      order.append(n)
+      stack.extend(n.children.values())
+    for n in reversed(order):  # children first, so parents unlink empty
+      del n.parent.children[n.key]
+      del self._lru[n]
+      self.allocator.decref(n.block)
+    self.evictions += len(order)
+    return len(order)
+
+  # ---------------------------------------------------------------- match
+
+  def match(self, prefix: np.ndarray) -> List[int]:
+    """Walk the tree with ``prefix``; return the matched blocks (root
+    order), each carrying ONE fresh reference for the caller's block
+    table.  Matching is capped strictly before the last token — the
+    divergent/partial block is always rebuilt by prefill, never shared
+    (COW rule, module docstring).  Counts one hit (any block matched)
+    or one miss per call."""
+    prefix = np.asarray(prefix, np.int32).reshape(-1)
+    bs = self.block_size
+    limit = max(0, int(prefix.size) - 1) // bs
+    node, path = self._root, []
+    for d in range(limit):
+      child = node.children.get(prefix[d * bs:(d + 1) * bs].tobytes())
+      if child is None:
+        break
+      path.append(child)
+      node = child
+    if not path:
+      self.misses += 1
+      return []
+    self.hits += 1
+    self.blocks_reused += len(path)
+    now = self.clock()
+    self._touch_path(path, now)
+    for n in path:
+      self.allocator.incref(n.block)
+    return [n.block for n in path]
+
+  # ------------------------------------------------------------- register
+
+  def register(self, tokens: np.ndarray, num_blocks: int,
+               blocks: List[int]) -> int:
+    """Insert the first ``num_blocks`` full blocks of ``tokens`` (backed
+    by ``blocks[:num_blocks]``) into the tree, increffing each NEWLY
+    inserted block.  The caller guarantees those blocks hold committed,
+    fully-written K/V for exactly those tokens (scheduler.py registers
+    at commit watermarks only).  On content collision the EXISTING node
+    wins — first writer keeps the canonical block; the duplicate stays
+    privately owned by its slot and frees on retirement.  Returns the
+    number of new insertions."""
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    bs = self.block_size
+    num_blocks = min(num_blocks, int(tokens.size) // bs, len(blocks))
+    node, path, added = self._root, [], 0
+    now = self.clock()
+    for d in range(num_blocks):
+      key = tokens[d * bs:(d + 1) * bs].tobytes()
+      child = node.children.get(key)
+      if child is None:
+        blk = blocks[d]
+        if blk == NULL_BLOCK:
+          break  # trash row: garbage content, never shareable
+        self.allocator.incref(blk)
+        child = _Node(key, blk, node, now)
+        node.children[key] = child
+        self._lru[child] = None
+        added += 1
+      path.append(child)
+      node = child
+    if path:
+      self._touch_path(path, now)
+    if self.max_cached_blocks > 0:
+      self._enforce_budget()
+    return added
+
+  def _enforce_budget(self) -> None:
+    # Over-budget: shed least-recent leaves regardless of refcount (a
+    # still-mapped block just loses its tree entry; the slot's own
+    # reference keeps it alive).
+    while len(self._lru) > self.max_cached_blocks:
+      front = next(iter(self._lru))
+      self._remove_subtree(front)
+
+  # ------------------------------------------------------------- eviction
+
+  def evict_for_space(self, need: int) -> int:
+    """Free up to ``need`` pool blocks by dropping least-recent cached
+    entries whose tree reference is the LAST one (unmapped by any slot
+    — dropping them returns the block to the free list immediately).
+    Mapped entries are skipped: a shared block must never be freed
+    while a table points at it.  One front-to-back sweep suffices — a
+    parent freed of its last child is newer than the child, so the
+    sweep reaches it afterwards.  Returns blocks actually freed; the
+    scheduler tries this BEFORE preempting any live slot."""
+    freed = 0
+    for node in list(self._lru):
+      if freed >= need:
+        break
+      if node.children or self.allocator.refcount(node.block) != 1:
+        continue
+      self._remove_subtree(node)
+      freed += 1
+    return freed
+
+  def expire(self, now: Optional[float] = None) -> int:
+    """Drop every entry idle past ``session_ttl_s`` (0 = never).  The
+    LRU front is the least-recent node, so expiry pops from the front
+    until it meets a live entry — O(expired), not O(tree).  Called by
+    the scheduler each plan step."""
+    if self.session_ttl_s <= 0 or not self._lru:
+      return 0
+    now = self.clock() if now is None else now
+    deadline = now - self.session_ttl_s
+    dropped = 0
+    while self._lru:
+      front = next(iter(self._lru))
+      if front.last_touch > deadline:
+        break
+      dropped += self._remove_subtree(front)
+    return dropped
+
+  def invalidate_blocks(self, blocks: Iterable[int]) -> int:
+    """Remove every entry backed by one of ``blocks`` (plus its subtree
+    — descendants become unreachable once the path breaks).  The
+    resilient engine calls this for blocks its sanitize pass zeroed
+    (engine.py ``_handle_bad_slots``): zeroed K/V must never satisfy a
+    future match.  Defensive — commit-gated registration means a bad
+    step's writes land past every registered block — but cheap
+    insurance against serving garbage."""
+    bad = set(int(b) for b in blocks)
+    if not bad:
+      return 0
+    doomed = [n for n in self._lru if n.block in bad]
+    removed = 0
+    for node in doomed:
+      if node in self._lru:  # not already gone with an ancestor's subtree
+        removed += self._remove_subtree(node)
+    return removed
+
+  def clear(self) -> int:
+    """Drop everything (tests + engine shutdown): releases every tree
+    reference so ``kv_blocks_used`` falls back to the live slots'."""
+    removed = 0
+    for child in list(self._root.children.values()):
+      removed += self._remove_subtree(child)
+    return removed
+
+  def __repr__(self):
+    return (f"PrefixCache(blocks={self.num_cached_blocks}, "
+            f"hits={self.hits}, misses={self.misses}, "
+            f"reused={self.blocks_reused}, evictions={self.evictions})")

@@ -68,7 +68,6 @@ from __future__ import annotations
 
 import dataclasses
 import time
-import zlib
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
@@ -80,6 +79,7 @@ from easyparallellibrary_tpu.observability import trace as trace_lib
 from easyparallellibrary_tpu.observability.registry import (
     FLEET_NAMESPACE, MetricRegistry)
 from easyparallellibrary_tpu.profiler.serving import fleet_summary
+from easyparallellibrary_tpu.serving.prefix_cache import block_prefix_keys
 from easyparallellibrary_tpu.serving.replica import EngineReplica
 from easyparallellibrary_tpu.serving.resilience import ReplicaHealth
 from easyparallellibrary_tpu.serving.scheduler import (
@@ -88,11 +88,14 @@ from easyparallellibrary_tpu.serving.transport import (
     InprocTransport, ProcessTransport, TransportError)
 from easyparallellibrary_tpu.utils.logging import get_logger
 
-# Prompt tokens hashed for prefix-affinity routing: long enough to
-# separate system prompts / few-shot templates, short enough that two
-# requests sharing a template hash together even when their user
-# payloads diverge.
-AFFINITY_PREFIX_TOKENS = 16
+# Prefix-affinity routing hashes BLOCK-ALIGNED prefix content — the
+# same content keys the prefix cache's radix tree matches at
+# (serving/prefix_cache.py block_prefix_keys), one key per full-block
+# depth up to AFFINITY_MAX_BLOCKS.  Routing and block reuse thereby
+# agree on what "same prefix" means: a request routed on a depth-d key
+# lands on the replica whose tree holds exactly those d blocks warm,
+# and the deepest matching depth wins (longest shared prefix = most
+# prefill skipped).
 # Bounded prefix->replica map (LRU): affinity is a locality hint, not
 # state — evicting an entry only costs a cold route.
 AFFINITY_CAPACITY = 4096
@@ -126,6 +129,11 @@ class Router:
     self._root_config = root_config
     self._drain_timeout_s = rconf.drain_timeout_s
     self._affinity_enabled = rconf.affinity
+    # Affinity keys are block-aligned content hashes (module constant
+    # note): the block size comes from the paged config so routing and
+    # each replica's prefix cache carve prompts at the same boundaries
+    # — even when paging is off, the fixed carve keeps keys stable.
+    self._affinity_block = root_config.serving.paged.block_size
     self._heartbeat_s = rconf.heartbeat_s
     self._suspect_after = rconf.suspect_after
     self._down_after = rconf.down_after
@@ -313,11 +321,12 @@ class Router:
 
   # ----------------------------------------------------------- dispatch
 
-  @staticmethod
-  def _prefix_hash(prompt: np.ndarray) -> int:
-    return zlib.crc32(
-        np.ascontiguousarray(
-            prompt[:AFFINITY_PREFIX_TOKENS], dtype=np.int32).tobytes())
+  def _prefix_keys(self, prompt: np.ndarray) -> List[int]:
+    """Block-aligned content keys for ``prompt``, shallowest first —
+    the SAME hashing the prefix cache's radix tree matches at
+    (prefix_cache.block_prefix_keys), so a deep affinity hit predicts a
+    deep block-reuse hit on the target replica."""
+    return block_prefix_keys(prompt, self._affinity_block)
 
   def _remember_affinity(self, key: int, index: int) -> None:
     self._affinity.pop(key, None)
@@ -349,13 +358,16 @@ class Router:
       self._rr = (self._rr + 1) % len(routable)
       return routable[self._rr], "round_robin"
     if self._affinity_enabled:
-      aff = self._affinity.get(self._prefix_hash(prompt))
-      if (aff is not None and aff in routable
-          and self.replicas[aff].load < self.replicas[aff].num_slots):
-        # Warm prefix AND spare capacity: locality wins.  A saturated
-        # affinity target falls through to least-loaded — affinity is a
-        # tiebreak, never a queueing reason.
-        return aff, "affinity"
+      # Deepest matching depth first: the longest shared block-aligned
+      # prefix names the replica holding the most of this prompt warm.
+      for key in reversed(self._prefix_keys(prompt)):
+        aff = self._affinity.get(key)
+        if (aff is not None and aff in routable
+            and self.replicas[aff].load < self.replicas[aff].num_slots):
+          # Warm prefix AND spare capacity: locality wins.  A saturated
+          # affinity target falls through to least-loaded — affinity is
+          # a tiebreak, never a queueing reason.
+          return aff, "affinity"
     idx = min(routable, key=lambda i: (self.replicas[i].load, i))
     return idx, "least_loaded"
 
@@ -430,7 +442,11 @@ class Router:
       if accepted:
         self.placement[request.uid] = idx
         if self._affinity_enabled:
-          self._remember_affinity(self._prefix_hash(prompt), idx)
+          # Every depth remembers the placement: a future prompt
+          # sharing only a SHALLOWER block-aligned prefix still finds
+          # the warm replica through its own deepest common key.
+          for key in self._prefix_keys(prompt):
+            self._remember_affinity(key, idx)
       else:
         # The replica's admission control shed it and recorded the
         # resolution in ITS finished map; mirror fleet-side so callers

@@ -259,7 +259,7 @@ class _SlotState:
   __slots__ = ("req", "slot", "prompt_pos", "generated", "key", "prefix",
                "submitted_at", "admitted_at", "first_token_at",
                "first_token_emitted", "requeues", "bad_streak",
-               "admit_seq")
+               "admit_seq", "reg_blocks")
 
   def __init__(self, req: Request, slot: int, submitted_at: float,
                now: float, carried: Optional["_SlotState"] = None,
@@ -276,6 +276,10 @@ class _SlotState:
     # requeued request gets a FRESH seq on readmission — it re-enters as
     # the youngest and cannot immediately steal back its old blocks.
     self.admit_seq = admit_seq
+    # Leading blocks already registered in (or mapped from) the prefix
+    # cache — the commit-time registration watermark, so the tree walk
+    # only runs when a new full block completes.
+    self.reg_blocks = 0
     if carried is not None:
       self.generated: List[int] = carried.generated
       self.key = carried.key
@@ -354,9 +358,13 @@ class FCFSScheduler:
                max_batch: int = 0, stop_token: int = -1,
                spec_k: int = 0, clock: Callable[[], float] = time.monotonic,
                block_size: int = 0, num_blocks: int = 0,
-               token_budget: int = 0, track_prefix: str = "serving"):
+               token_budget: int = 0, track_prefix: str = "serving",
+               prefix_cache: bool = False,
+               prefix_session_ttl_s: float = 0.0,
+               prefix_max_cached_blocks: int = 0):
     from easyparallellibrary_tpu.serving.kv_cache import (
         BlockAllocator, SlotAllocator)
+    from easyparallellibrary_tpu.serving.prefix_cache import PrefixCache
     if prefill_chunk < 1:
       raise ValueError(f"prefill_chunk must be >= 1: {prefill_chunk}")
     if prefill_token_budget < 0 or max_batch < 0:
@@ -395,10 +403,26 @@ class FCFSScheduler:
       # queues behind a throughput slot's blocks (ROADMAP item 5
       # leftover; _preempt_for_latency_admission).
       self.proactive_preemptions = 0
+      # Copy-on-write prefix caching (serving/prefix_cache.py): a radix
+      # tree over committed prompt blocks.  Admission maps matched
+      # blocks by reference and skips their prefill; retirement leaves
+      # blocks pinned under the TTL/LRU budget (session persistence).
+      self.prefix_cache = (
+          PrefixCache(self.block_allocator, block_size,
+                      session_ttl_s=prefix_session_ttl_s,
+                      max_cached_blocks=prefix_max_cached_blocks,
+                      clock=clock)
+          if prefix_cache else None)
     else:
+      if prefix_cache:
+        raise ValueError(
+            "prefix caching shares KV at block granularity and therefore "
+            "requires the paged cache: enable serving.paged (block_size "
+            "> 0) alongside serving.prefix_cache")
       self.block_size = 0
       self.token_budget = 0
       self.block_allocator = None
+      self.prefix_cache = None
     self._admit_seq = 0
     # Max speculative drafts per decode slot per step (0 = engine has no
     # drafter); per-request Request.speculative=False opts out, and the
@@ -884,6 +908,26 @@ class FCFSScheduler:
       self.active[slot] = state
       self._deadline_active += self._has_deadline(req)
       self._admit_order.append(slot)
+      # Warm admission (serving/prefix_cache.py): walk the radix tree
+      # with the request's prefix (prompt, plus the committed replay
+      # for a requeued one).  Matched blocks map into the table by
+      # reference — each already carries one fresh refcount from
+      # match() — and the prompt cursor jumps past them, so chunked
+      # prefill only ever feeds the unmatched tail.  The match cap
+      # (strictly before the last prefix token) guarantees prompt_pos
+      # stays short of len(prefix): the slot still runs at least one
+      # prefill step, keeping first-token emission on its normal path.
+      reused = 0
+      if self.paged and self.prefix_cache is not None:
+        matched = self.prefix_cache.match(state.prefix)
+        if matched:
+          blocks = self._slot_blocks.setdefault(slot, [])
+          for blk in matched:
+            self._tables[slot, len(blocks)] = blk
+            blocks.append(blk)
+          reused = len(matched)
+          state.prompt_pos = reused * self.block_size
+          state.reg_blocks = reused
       # The request's lifecycle span opens on its slot's track and stays
       # open until _retire — every per-step prefill/decode span the
       # engine records for this slot nests inside it, so one Perfetto
@@ -893,6 +937,8 @@ class FCFSScheduler:
         args = {"uid": str(req.uid),
                 "prompt_tokens": int(len(req.prompt)),
                 "max_new_tokens": int(req.max_new_tokens)}
+        if reused:
+          args["prefix_blocks_reused"] = int(reused)
         if state.requeues:
           args["requeues"] = int(state.requeues)
         tracer.begin(f"request {req.uid}", cat="serving.request",
@@ -949,6 +995,63 @@ class FCFSScheduler:
     for blk in self._slot_blocks.pop(slot, ()):  # noqa: B909
       self.block_allocator.decref(blk)
     self._tables[slot] = 0
+
+  # --------------------------------------------------- prefix-cache interop
+
+  @property
+  def prefix_hits(self) -> int:
+    return self.prefix_cache.hits if self.prefix_cache is not None else 0
+
+  @property
+  def prefix_misses(self) -> int:
+    return self.prefix_cache.misses if self.prefix_cache is not None else 0
+
+  @property
+  def prefix_blocks_reused(self) -> int:
+    return (self.prefix_cache.blocks_reused
+            if self.prefix_cache is not None else 0)
+
+  @property
+  def prefix_evictions(self) -> int:
+    return (self.prefix_cache.evictions
+            if self.prefix_cache is not None else 0)
+
+  @property
+  def prefix_cached_blocks(self) -> int:
+    return (self.prefix_cache.num_cached_blocks
+            if self.prefix_cache is not None else 0)
+
+  def invalidate_cached_blocks(self, blocks) -> int:
+    """Purge ``blocks`` from the prefix cache (engine sanitize: zeroed
+    K/V must never satisfy a future match).  No-op without a cache."""
+    if self.prefix_cache is None:
+      return 0
+    return self.prefix_cache.invalidate_blocks(blocks)
+
+  def _register_cached(self, state: _SlotState) -> None:
+    """Register ``state``'s newly COMPLETED full blocks in the prefix
+    tree — called at commit watermarks (prefill advance, decode block
+    boundaries) and at retirement (session persistence).  Only blocks
+    strictly below the committed-K/V watermark register, so a tree
+    entry always describes fully-written, commit-gated content; the
+    partial tail block (and any position a bad step may have scribbled
+    on) stays private to the slot."""
+    upto = self._resident_tokens(state)
+    n = upto // self.block_size
+    blocks = self._slot_blocks.get(state.slot)
+    if blocks is not None:
+      n = min(n, len(blocks))
+    else:
+      n = 0
+    if n <= state.reg_blocks:
+      return
+    if state.prefilling:
+      tokens = state.prefix  # covers [0, prompt_pos) — exactly what fed
+    else:
+      tokens = np.concatenate(
+          [state.req.prompt, np.asarray(state.generated, np.int32)])
+    self.prefix_cache.register(tokens, n, blocks)
+    state.reg_blocks = n
 
   def _preemption_victim(self, req_rank, excluded: set) -> Optional[int]:
     """Shared eligibility rule for BOTH preemption paths (pool
@@ -1041,6 +1144,17 @@ class FCFSScheduler:
     while len(blocks) < need:
       blk = self.block_allocator.alloc()
       if blk is None:
+        # Reclamation order on a dry pool: cached-but-unmapped prefix
+        # blocks first (pure cache — dropping them costs a future
+        # admission some prefill, never a live request its progress),
+        # preemption only once the tree has nothing evictable.  A
+        # preempted victim's released blocks may themselves become
+        # tree-only references, which the NEXT iteration's eviction
+        # pass then reclaims.
+        if (self.prefix_cache is not None
+            and self.prefix_cache.evict_for_space(
+                need - len(blocks)) > 0):
+          continue
         if not preempt or self._preempt_for_blocks(slot, scheduled) is None:
           break
         continue
@@ -1195,6 +1309,11 @@ class FCFSScheduler:
     ``num_valid=0`` this step and resumes next step.
     """
     self.expire()
+    if self.prefix_cache is not None:
+      # Session TTL sweep before admission, so an expired session can
+      # never satisfy this iteration's matches.  O(expired) — the
+      # cache's LRU front is its least-recent entry.
+      self.prefix_cache.expire()
     self._admit()
     if self.paged:
       return self._plan_flat()
@@ -1274,6 +1393,14 @@ class FCFSScheduler:
     del self.active[slot]
     self._admit_order.remove(slot)
     self.allocator.free(slot)
+    # Session KV persistence: register the retiring request's completed
+    # blocks BEFORE releasing the slot's references, so a multi-turn
+    # follow-up (its next prompt = this conversation's full history)
+    # admits warm.  The tree's own references keep the blocks resident
+    # under its TTL/LRU budget.  A quarantine-overflow retirement
+    # ("failed") never registers — its device state is untrusted.
+    if self.prefix_cache is not None and reason != "failed":
+      self._register_cached(state)
     self._release_blocks(slot)
     self._deadline_active -= self._has_deadline(state.req)
     tracer = trace_lib.get_tracer()
@@ -1337,7 +1464,13 @@ class FCFSScheduler:
       if state.prefilling:
         state.prompt_pos += int(plan.num_valid[slot])
         if state.prefilling:
-          continue  # more prompt to feed; discard the sample
+          # More prompt to feed; discard the sample — but the chunk
+          # just committed may have COMPLETED full blocks: register
+          # them now so a concurrent same-prefix admission already
+          # shares them mid-prefill.
+          if self.prefix_cache is not None:
+            self._register_cached(state)
+          continue
         if not state.first_token_emitted:
           state.first_token_emitted = True
           state.first_token_at = now
@@ -1362,4 +1495,10 @@ class FCFSScheduler:
         if len(state.generated) >= req.max_new_tokens:
           self._retire(state, "length")
           break
+      # Decode watermark registration: committed tokens may have pushed
+      # the written-K/V frontier across a block boundary — register the
+      # freshly completed block(s).  A retirement above already
+      # registered via _retire; `is state` guards the stale reference.
+      if self.prefix_cache is not None and self.active.get(slot) is state:
+        self._register_cached(state)
     return self.take_finished()

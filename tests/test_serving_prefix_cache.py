@@ -1,0 +1,427 @@
+"""Fleet-wide copy-on-write prefix caching (ISSUE 16).
+
+The exactness contract under test: warm admission is a pure PLANNING
+change — matched blocks map into the block table by reference and the
+prompt cursor jumps past them, but every token the engine emits is
+bit-identical to a cold prefill of the same prompt, no matter how much
+of the prompt came out of the radix tree, when the sharer was admitted,
+or whether cached blocks were evicted mid-flight to refill the pool.
+Compile count stays 1 across hit/miss/evict (block tables are data).
+The host-side tree is pure numpy/zlib, so its refcount and LRU
+invariants are pinned at unit level with a fake clock; the engine-level
+tests pin the end-to-end streams against ``generate(use_cache=True)``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import easyparallellibrary_tpu as epl
+from easyparallellibrary_tpu.models import GPT, GPTConfig
+from easyparallellibrary_tpu.models.gpt import generate
+from easyparallellibrary_tpu.serving import (
+    BlockAllocator, ContinuousBatchingEngine, PrefixCache, Request,
+    block_prefix_keys)
+from easyparallellibrary_tpu.testing import chaos
+
+TINY = GPTConfig(vocab_size=64, num_layers=2, num_heads=4, d_model=32,
+                 d_ff=64, max_seq_len=32, dtype=jnp.float32)
+
+
+def _model_and_params(cfg=TINY, seed=0):
+  model = GPT(cfg)
+  params = model.init(jax.random.PRNGKey(seed),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+  return model, params
+
+
+def _oracle(model, params, prompt, max_new):
+  return np.asarray(
+      generate(model, params, jnp.asarray(prompt)[None], max_new))[0]
+
+
+def _warm_engine(model, params, **kw):
+  kw.setdefault("num_slots", 2)
+  kw.setdefault("prefill_chunk", 4)
+  kw.setdefault("paged", True)
+  kw.setdefault("block_size", 4)
+  kw.setdefault("prefix_cache", True)
+  return ContinuousBatchingEngine(model, params, **kw)
+
+
+# ------------------------------------------------------------- unit: keys
+
+
+def test_block_prefix_keys_block_aligned_and_chained():
+  """Router affinity keys are per-full-block chained digests: a shared
+  leading block yields a shared depth-1 key even when the prompts
+  diverge later, keys only extend with COMPLETE extra blocks, and the
+  short-prompt fallback hashes the whole prompt under a distinct salt
+  (a 1-block prompt and its 4-token prefix must not collide)."""
+  a = np.arange(1, 13, dtype=np.int32)           # 12 tokens, 3 blocks
+  b = np.concatenate([a[:8], a[8:] + 7])         # diverges in block 2
+  ka, kb = block_prefix_keys(a, 4), block_prefix_keys(b, 4)
+  # Full blocks strictly before the last token: (12-1)//4 = 2 depths.
+  assert len(ka) == len(kb) == 2
+  assert ka[0] == kb[0] and ka[1] == kb[1]
+  c = np.concatenate([a[:4], a[4:8] + 7, a[8:]])  # diverges in block 1
+  kc = block_prefix_keys(c, 4)
+  assert kc[0] == ka[0] and kc[1] != ka[1]
+  # Chaining: depth-2 key depends on depth-1 content, not just block 2.
+  assert block_prefix_keys(np.concatenate([c[:8], a[8:]]), 4)[1] != ka[1]
+  # max_blocks caps the walk.
+  assert block_prefix_keys(a, 4, max_blocks=1) == ka[:1]
+  # Prompts covering no full block (strictly before their last token)
+  # fall back to a whole-prompt key under a distinct salt: a 4-token
+  # prompt must not collide with the depth-1 digest of those 4 tokens.
+  short = block_prefix_keys(a[:4], 4)
+  assert len(short) == 1 and short[0] != ka[0]
+  assert block_prefix_keys(a[:3], 4) != short
+
+
+# -------------------------------------------- unit: refcounts + eviction
+
+
+def test_radix_refcount_and_eviction_invariants():
+  """Tree entries hold their own refcount: a registered block survives
+  its owner's release, a matched block survives tree eviction, and
+  ``evict_for_space`` only ever frees leaves nobody maps (refcount 1),
+  parents strictly after their children."""
+  alloc = BlockAllocator(num_blocks=16, block_size=4)
+  cache = PrefixCache(alloc, block_size=4)
+  toks = np.arange(1, 13, dtype=np.int32)
+  owned = [alloc.alloc() for _ in range(3)]
+  assert cache.register(toks, 3, owned) == 3
+  assert cache.num_cached_blocks == 3
+  for b in owned:
+    assert alloc.refcount(b) == 2       # owner + tree
+  # Owner retires: blocks now pinned by the tree alone.
+  for b in owned:
+    alloc.decref(b)
+  assert all(alloc.refcount(b) == 1 for b in owned)
+  # A sharer matches the first two blocks (strictly before the last
+  # prefix token: (12-1)//4 = 2) and increfs them.
+  matched = cache.match(toks)
+  assert matched == owned[:2]
+  assert cache.hits == 1 and cache.blocks_reused == 2
+  assert [alloc.refcount(b) for b in owned] == [2, 2, 1]
+  # Eviction sweep: only the unmapped leaf (owned[2]) is reclaimable —
+  # owned[:2] are mapped (refcount 2) and owned[0] is an inner node.
+  assert cache.evict_for_space(need=3) == 1
+  assert cache.num_cached_blocks == 2
+  assert alloc.refcount(owned[2]) == 0          # returned to the pool
+  assert [alloc.refcount(b) for b in owned[:2]] == [2, 2]
+  # The sharer's mapping is untouched by eviction; releasing it makes
+  # the remaining chain evictable deepest-first in ONE sweep (a parent
+  # freed of its last child is re-touched newer, visited later).
+  for b in matched:
+    alloc.decref(b)
+  assert cache.evict_for_space(need=8) == 2
+  assert cache.num_cached_blocks == 0
+  assert alloc.num_free == 15                   # all but NULL_BLOCK
+
+
+def test_radix_register_dedup_and_budget():
+  """Registering the same content twice keeps the FIRST physical block
+  (the duplicate owner keeps its copy unshared), and ``max_cached_blocks``
+  sheds LRU-front leaves even while mapped — the budget bounds the
+  TREE's pin count, not sharers' mappings."""
+  alloc = BlockAllocator(num_blocks=16, block_size=4)
+  cache = PrefixCache(alloc, block_size=4, max_cached_blocks=2)
+  toks = np.arange(1, 13, dtype=np.int32)
+  first = [alloc.alloc() for _ in range(2)]
+  cache.register(toks, 2, first)
+  dup = [alloc.alloc() for _ in range(2)]
+  cache.register(toks, 2, dup)
+  # Existing nodes win: no extra pin on the duplicates.
+  assert cache.num_cached_blocks == 2
+  assert all(alloc.refcount(b) == 2 for b in first)
+  assert all(alloc.refcount(b) == 1 for b in dup)
+  # A third distinct chain overflows the budget: the oldest leaf goes.
+  other = np.arange(40, 52, dtype=np.int32)
+  blks = [alloc.alloc() for _ in range(2)]
+  before = cache.evictions
+  cache.register(other, 2, blks)
+  assert cache.num_cached_blocks == 2
+  assert cache.evictions > before
+
+
+def test_session_ttl_expiry_fake_clock():
+  """TTL expiry pops stale entries from the LRU front only: a re-matched
+  (touched) chain survives the sweep that reclaims an untouched one, and
+  expired blocks return to the pool."""
+  now = [0.0]
+  alloc = BlockAllocator(num_blocks=16, block_size=4)
+  cache = PrefixCache(alloc, block_size=4, session_ttl_s=10.0,
+                      clock=lambda: now[0])
+  a = np.arange(1, 13, dtype=np.int32)
+  b = np.arange(40, 52, dtype=np.int32)
+  for toks in (a, b):
+    blks = [alloc.alloc() for _ in range(2)]
+    cache.register(toks, 2, blks)
+    for blk in blks:
+      alloc.decref(blk)                  # session-retired: tree-only
+  assert cache.num_cached_blocks == 4
+  now[0] = 8.0
+  for blk in cache.match(a):             # refresh chain A...
+    alloc.decref(blk)
+  assert cache.expire() == 0             # ...nothing stale yet
+  now[0] = 12.0                          # B untouched since t=0
+  assert cache.expire() == 2
+  assert cache.num_cached_blocks == 2
+  assert cache.match(b) == []
+  survivors = cache.match(a)
+  assert survivors
+  for blk in survivors:
+    alloc.decref(blk)
+  now[0] = 25.0
+  assert cache.expire() == 2
+  assert alloc.num_free == 15
+
+
+# --------------------------------------------------- engine: bit-exactness
+
+
+@pytest.mark.quick
+def test_warm_admission_bit_exact_with_cow_divergence():
+  """Session reuse end to end: requests served one after another share
+  prompt prefixes through the radix tree — including one that diverges
+  MID-block and one that forks right after the shared blocks — and every
+  warm stream matches its from-scratch oracle bit-exactly.  Hit/reuse
+  counters advance, the tree never double-frees (all non-pinned blocks
+  return to the pool), and the fused step compiles once."""
+  epl.init()
+  model, params = _model_and_params()
+  base = np.arange(1, 9, dtype=np.int32)           # 2 full shared blocks
+  prompts = [
+      np.concatenate([base, [9]]),                 # seeds the tree
+      np.concatenate([base, [10, 11]]),            # forks after block 2
+      np.concatenate([base[:6], [12, 13, 14]]),    # diverges inside blk 2
+  ]
+  eng = _warm_engine(model, params, num_slots=4)
+  out = {}
+  for i, p in enumerate(prompts):
+    eng.submit(Request(uid=i, prompt=np.asarray(p, np.int32),
+                       max_new_tokens=6))
+    out.update(eng.run())                          # sequential sessions
+  assert eng._step_fn._cache_size() == 1
+  for i, p in enumerate(prompts):
+    np.testing.assert_array_equal(out[i], _oracle(model, params, p, 6),
+                                  err_msg=f"req {i}")
+  s = eng.scheduler
+  assert s.prefix_hits == 2 and s.prefix_misses == 1
+  # r1 reuses both shared blocks; r2 only the first (divergence lands
+  # inside the second block, which COW rebuilds fresh).
+  assert s.prefix_blocks_reused == 3
+  # Live slots all retired: every block still held is a tree pin.
+  assert s.kv_blocks_used == s.prefix_cached_blocks > 0
+
+
+@pytest.mark.quick
+def test_cow_shares_physical_blocks_never_writes_through():
+  """The sharing is real: a warm request's leading table entries are the
+  SAME physical blocks its predecessor wrote (refcount counts both the
+  tree and the live mapping), and after the sharer decodes past the
+  shared region its divergent tail lands in fresh blocks — re-matching
+  the original prefix still returns the original content."""
+  epl.init()
+  model, params = _model_and_params(seed=2)
+  base = np.arange(1, 9, dtype=np.int32)
+  eng = _warm_engine(model, params)
+  eng.submit(Request(uid="seed", prompt=np.concatenate([base, [9]]),
+                     max_new_tokens=4))
+  out = eng.run()
+  tree_blocks = list(eng.scheduler.prefix_cache.match(
+      np.concatenate([base, [9]])))
+  for b in tree_blocks:
+    eng.scheduler.block_allocator.decref(b)        # probe only
+  assert len(tree_blocks) == 2
+  eng.submit(Request(uid="fork", prompt=np.concatenate([base, [10, 11]]),
+                     max_new_tokens=6))
+  eng.step()
+  slot = next(iter(eng.scheduler.active))
+  mapped = eng.scheduler.slot_blocks(slot)
+  assert mapped[:2] == tree_blocks                 # physical overlap
+  for b in tree_blocks:                            # tree + live sharer
+    assert eng.scheduler.block_allocator.refcount(b) >= 2
+  out.update(eng.run())
+  np.testing.assert_array_equal(
+      out["fork"],
+      _oracle(model, params, np.concatenate([base, [10, 11]]), 6))
+  # Shared content untouched by the fork's decode: a third request over
+  # the ORIGINAL prompt still reproduces its oracle through the tree.
+  eng.submit(Request(uid="again", prompt=np.concatenate([base, [9]]),
+                     max_new_tokens=4))
+  out.update(eng.run())
+  np.testing.assert_array_equal(out["again"], out["seed"])
+
+
+@pytest.mark.quick
+def test_fault_free_guard_unique_prompts_identical_to_baseline():
+  """Cache ON with nothing shareable is a no-op: unique prompts produce
+  the identical stream a cache-off engine produces, hits stay 0, and
+  the fused step still compiles exactly once."""
+  epl.init()
+  model, params = _model_and_params(seed=3)
+  r = np.random.RandomState(11)
+  prompts = [r.randint(0, 64, (n,)).astype(np.int32)
+             for n in (5, 9, 3, 7)]
+
+  def drive(prefix_cache):
+    eng = _warm_engine(model, params, num_slots=2,
+                       prefix_cache=prefix_cache)
+    for i, p in enumerate(prompts):
+      eng.submit(Request(uid=i, prompt=p, max_new_tokens=5))
+    out = eng.run(max_steps=300)
+    assert eng._step_fn._cache_size() == 1
+    return eng, out
+
+  warm_eng, warm = drive(True)
+  _, cold = drive(False)
+  for i in range(len(prompts)):
+    np.testing.assert_array_equal(warm[i], cold[i], err_msg=f"req {i}")
+  assert warm_eng.scheduler.prefix_hits == 0
+  assert warm_eng.scheduler.prefix_misses == len(prompts)
+
+
+@pytest.mark.quick
+def test_warm_tp2_staggered_admission_bit_exact():
+  """Warm admission composes with TP=2 sharded serving and mid-flight
+  joins: a sharer admitted into a RUNNING batch maps the retiree's
+  blocks by reference and still matches the single-device oracle."""
+  from easyparallellibrary_tpu.parallel import (
+      TrainState, create_sharded_train_state)
+  import optax
+  epl.init(epl.Config({"cluster.mesh_shape": "data:4,model:2"}))
+  mesh = epl.Env.get().cluster.build_mesh()
+  cfg = GPTConfig(**{**TINY.__dict__, "tensor_parallel": True})
+  model = GPT(cfg)
+  base = np.arange(1, 9, dtype=np.int32)
+  prompts = [np.concatenate([base, [9]]).astype(np.int32),
+             np.concatenate([base, [10, 11]]).astype(np.int32),
+             np.arange(20, 27, dtype=np.int32)]
+
+  def init_fn(rng):
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=model.init(rng, jnp.asarray(prompts[0])[None])["params"],
+        tx=optax.sgd(0.1))
+
+  state, _ = create_sharded_train_state(init_fn, mesh,
+                                        jax.random.PRNGKey(5))
+
+  def drive(prefix_cache):
+    eng = ContinuousBatchingEngine(model, state.params, mesh=mesh,
+                                   num_slots=2, prefill_chunk=4,
+                                   paged=True, block_size=4,
+                                   prefix_cache=prefix_cache)
+    eng.submit(Request(uid=0, prompt=prompts[0], max_new_tokens=5))
+    out = eng.run()                                # seeds the tree
+    eng.submit(Request(uid=2, prompt=prompts[2], max_new_tokens=6))
+    for fin in eng.step():                         # unrelated req running
+      out[fin.uid] = fin.tokens
+    eng.submit(Request(uid=1, prompt=prompts[1], max_new_tokens=5))
+    out.update(eng.run())                          # warm join mid-flight
+    assert eng._step_fn._cache_size() == 1
+    return eng, out
+
+  warm_eng, warm = drive(True)
+  assert warm_eng.scheduler.prefix_hits >= 1
+  _, cold = drive(False)
+  for i in range(len(prompts)):
+    np.testing.assert_array_equal(warm[i], cold[i], err_msg=f"req {i}")
+
+
+# ------------------------------------------- engine: eviction + requeue
+
+
+@pytest.mark.quick
+def test_cached_blocks_evicted_before_any_preemption():
+  """Pool pressure reclaims session-cached (tree-only) blocks BEFORE
+  preempting any live slot: a pool sized so the second request cannot
+  prefill alongside the first one's retired session serves both without
+  a single preemption, and the evicted-session request still replays
+  its prompt cold bit-exactly."""
+  epl.init()
+  model, params = _model_and_params(seed=4)
+  r = np.random.RandomState(5)
+  p1 = r.randint(0, 64, (12,)).astype(np.int32)
+  p2 = r.randint(0, 64, (12,)).astype(np.int32)
+  # 8 usable blocks (minimum legal pool); each request needs
+  # ceil(22/4) = 6 blocks for prompt+generation, and the first leaves 5
+  # session blocks cached — the second CANNOT prefill its tail without
+  # reclaiming them from the tree.
+  eng = _warm_engine(model, params, num_slots=2, num_blocks=9)
+  eng.submit(Request(uid="a", prompt=p1, max_new_tokens=10))
+  out = eng.run(max_steps=300)
+  cached = eng.scheduler.prefix_cached_blocks
+  assert cached > 0
+  eng.submit(Request(uid="b", prompt=p2, max_new_tokens=10))
+  out.update(eng.run(max_steps=300))
+  assert eng.scheduler.preemptions == 0
+  assert eng.scheduler.prefix_evictions > 0
+  assert eng._step_fn._cache_size() == 1
+  for uid, p in (("a", p1), ("b", p2)):
+    np.testing.assert_array_equal(out[uid], _oracle(model, params, p, 10),
+                                  err_msg=uid)
+
+
+@pytest.mark.quick
+def test_requeue_rematches_own_prefix_and_releases_refs():
+  """A quarantined request's registered blocks stay pinned by the tree
+  across its requeue, so re-admission warm-matches its OWN committed
+  prefix (near-instant replay) — and the replayed stream is still the
+  oracle's.  The end state leaks nothing: every live refcount is a
+  tree pin."""
+  epl.init()
+  model, params = _model_and_params()
+  p = np.arange(1, 10, dtype=np.int32)
+  eng = _warm_engine(model, params, resilience=True)
+  inj = chaos.NaNLogitsInjector(eng, bad_calls=(2, 3))
+  eng.submit(Request(uid="q", prompt=p, max_new_tokens=6))
+  out = eng.run()
+  assert inj.poisoned == [2, 3]
+  assert eng.stats.requeues == 1
+  assert eng._step_fn._cache_size() == 1
+  # The replay admission hit the tree (its own commit-gated blocks).
+  assert eng.scheduler.prefix_hits >= 1
+  assert eng.finished["q"].finish_reason == "length"
+  np.testing.assert_array_equal(out["q"], _oracle(model, params, p, 6))
+  assert (eng.scheduler.kv_blocks_used
+          == eng.scheduler.prefix_cached_blocks)
+
+
+@pytest.mark.quick
+def test_evacuation_releases_tree_refs_clean():
+  """Evacuating a warm engine (failover migration) releases slot refs
+  while tree pins survive; clearing the cache afterwards returns every
+  block to the pool — no refcount is stranded by the migration."""
+  epl.init()
+  model, params = _model_and_params()
+  base = np.arange(1, 9, dtype=np.int32)
+  eng = _warm_engine(model, params)
+  eng.submit(Request(uid="s", prompt=np.concatenate([base, [9]]),
+                     max_new_tokens=4))
+  eng.run()
+  eng.submit(Request(uid="w", prompt=np.concatenate([base, [10, 11]]),
+                     max_new_tokens=8))
+  eng.step()                         # warm request mid-flight
+  assert eng.scheduler.prefix_hits == 1
+  snaps = eng.scheduler.evacuate()
+  assert [s["request"]["uid"] for s in snaps] == ["w"]
+  # Slot mappings gone; only tree pins remain.
+  assert eng.scheduler.kv_blocks_used == eng.scheduler.prefix_cached_blocks
+  assert eng.scheduler.prefix_cache.clear() > 0
+  assert eng.scheduler.kv_blocks_used == 0
+
+
+def test_prefix_cache_requires_paged():
+  """Config validation and the scheduler both reject prefix caching on
+  the contiguous engine — sharing is block-granular by construction."""
+  with pytest.raises(ValueError, match="paged"):
+    epl.Config({"serving.prefix_cache.enabled": True})
+  from easyparallellibrary_tpu.serving.scheduler import FCFSScheduler
+  with pytest.raises(ValueError, match="paged"):
+    FCFSScheduler(num_slots=2, prefill_chunk=4, max_seq_len=32,
+                  prefix_cache=True)
