@@ -75,6 +75,18 @@ chaos-proc:
 chaos-heal:
 	python -m pytest tests/test_serving_autoscale.py -q
 
+# Blue/green rollout chaos: begin a checkpoint rollout mid-traffic on a
+# process-transport fleet, SIGKILL one blue replica child during the
+# canary — its journaled requests fail over to the SURVIVING BLUE only
+# (cross-version replay is refused; complete-in-place migration), zero
+# requests lost, every response attributable to exactly one checkpoint
+# version, the survivor's compile count stays 1, and the rollout still
+# completes; plus the quick-marked contract pins (full rollout under
+# live traffic, canary-breach rollback blue-bit-exact, fault-free
+# guard) (serving/rollout.py; docs/robustness.md "Blue/green rollout").
+chaos-rollout:
+	python -m pytest tests/test_serving_rollout.py -q
+
 # Continuous batching vs static-batch generate() under Poisson arrivals
 # (benchmarks/decode_throughput.py -> BENCH_EVIDENCE.json; docs/serving.md).
 serve-bench:
@@ -114,6 +126,17 @@ overload-bench:
 heal-bench:
 	python benchmarks/self_heal.py
 
+# Blue/green rollout episode benchmark: one seeded Poisson trace served
+# by a never-rolled fleet, through a completed rollout, and through a
+# canary-breach rollback (in-process replicas — admission/drain policy,
+# not spawn cost, is what is measured; make chaos-rollout covers the
+# real spawn/kill path) — zero lost requests, zero recompiles, routable
+# capacity never below the pre-rollout floor, rollback restores blue
+# bit-exactly (benchmarks/rollout.py -> BENCH_EVIDENCE.json;
+# docs/robustness.md "Blue/green rollout").
+rollout-bench:
+	python benchmarks/rollout.py
+
 # Replica-kill failover episode: 1 vs 2 replicas under a Poisson trace,
 # then kill one mid-decode — zero lost requests, streams bit-exact vs
 # the fault-free baseline — on BOTH transports: in-process replicas,
@@ -151,7 +174,9 @@ help:
 	@echo "  chaos-router   - fleet chaos: replica kills, hangs, flapping health (both transports)"
 	@echo "  chaos-proc     - process-transport chaos: SIGKILL/SIGSTOP/lost replies/orphans"
 	@echo "  chaos-heal     - self-healing fleet: overload burst -> autotune + autoscale -> recover"
+	@echo "  chaos-rollout  - blue/green rollout chaos: SIGKILL a blue mid-canary, zero lost"
 	@echo "  heal-bench     - actuators-on vs frozen fleet under the overload burst"
+	@echo "  rollout-bench  - blue/green rollout episode: 0 lost, 0 recompiles, blue bit-exact rollback"
 	@echo "  serve-bench    - continuous batching vs static generate()"
 	@echo "  paged-bench    - paged vs contiguous KV cache (long-tail trace)"
 	@echo "  prefix-bench   - warm vs cold TTFT with prefix caching (Zipf + chat traces)"
@@ -166,4 +191,4 @@ help:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test lint perf-gate gate bench chaos chaos-serve chaos-router chaos-proc chaos-heal serve-bench paged-bench prefix-bench spec-bench overload-bench router-bench heal-bench trace-demo obs-bench help clean
+.PHONY: all build test lint perf-gate gate bench chaos chaos-serve chaos-router chaos-proc chaos-heal chaos-rollout serve-bench paged-bench prefix-bench spec-bench overload-bench router-bench heal-bench rollout-bench trace-demo obs-bench help clean

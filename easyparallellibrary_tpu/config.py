@@ -596,6 +596,35 @@ class ServingConfig(_Category):
       # Extra SLO rule names (beyond every burn-rate rule, which always
       # actuates) whose breaches trigger scale-up, e.g. "ttft_p99".
       "autoscale.rules": (),
+      # --- blue/green checkpoint rollout (serving/rollout.py,
+      # docs/robustness.md "Blue/green rollout").  A RolloutController
+      # on the router ships checkpoint N+1 under live traffic: validate
+      # the checkpoint, spawn green replicas off the sweep thread,
+      # shift admission weight green-ward in stages (canary fraction
+      # first, watched by version-scoped SLO breach streams), then cut
+      # over and drain blue complete-in-place — with automatic
+      # rollback (drain green, restore blue weights) on any
+      # canary-scoped breach or green spawn failure.
+      "rollout.enabled": False,
+      # Admission-weight fraction routed to green during the canary
+      # stage (the rest stays on blue).
+      "rollout.canary_frac": 0.1,
+      # How long the canary stage must run breach-free before full
+      # cutover.
+      "rollout.canary_hold_s": 10.0,
+      # Blues below this live count are never drained mid-rollout (the
+      # fleet's capacity floor while green capacity is still unproven).
+      "rollout.min_replicas": 1,
+      # Deadline for ALL green replicas to spawn + init; exceeded =
+      # rollback (greens drained, blue weights restored).
+      "rollout.spawn_timeout_s": 300.0,
+      # Graceful-drain window for blue replicas after cutover (their
+      # in-flight requests complete in place; leftovers past the
+      # window migrate — only ever to a same-version survivor).
+      "rollout.drain_timeout_s": 30.0,
+      # Extra SLO rule names (beyond every burn-rate rule) whose
+      # green-scoped breaches roll the canary back, e.g. "ttft_p99".
+      "rollout.rules": (),
   }
 
   @property
@@ -625,6 +654,10 @@ class ServingConfig(_Category):
   @property
   def autoscale(self) -> _SubGroup:
     return _SubGroup(self, "autoscale")
+
+  @property
+  def rollout(self) -> _SubGroup:
+    return _SubGroup(self, "rollout")
 
 
 class ObservabilityConfig(_Category):
@@ -1038,6 +1071,22 @@ class Config:
       if getattr(scale, field) < 0:
         raise ValueError(f"serving.autoscale.{field} must be >= 0; "
                          f"got {getattr(scale, field)}")
+    roll = self.serving.rollout
+    if not 0.0 < roll.canary_frac <= 1.0:
+      raise ValueError(
+          f"serving.rollout.canary_frac must be in (0, 1] (a zero "
+          f"canary never observes green under load); got "
+          f"{roll.canary_frac}")
+    if roll.min_replicas < 1:
+      raise ValueError(f"serving.rollout.min_replicas must be >= 1; "
+                       f"got {roll.min_replicas}")
+    for field in ("canary_hold_s", "drain_timeout_s"):
+      if getattr(roll, field) < 0:
+        raise ValueError(f"serving.rollout.{field} must be >= 0; "
+                         f"got {getattr(roll, field)}")
+    if roll.spawn_timeout_s <= 0:
+      raise ValueError(f"serving.rollout.spawn_timeout_s must be > 0; "
+                       f"got {roll.spawn_timeout_s}")
 
   def to_dict(self) -> Dict[str, Dict[str, Any]]:
     return {c._name: getattr(self, c._name).to_dict()

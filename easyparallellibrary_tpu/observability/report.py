@@ -392,6 +392,10 @@ class FollowState:
       moves = [f"level {ev['from_level']}->{ev['to_level']}"]
     if not moves and "action" in ev:
       moves = [f"{ev['action']} replica {ev.get('replica', '?')}"]
+    if not moves and "transition" in ev:
+      # Blue/green rollout transitions (serving/rollout.py).
+      moves = [f"{ev['transition']} v{ev.get('blue_version', '?')}"
+               f"->v{ev.get('green_version', '?')}"]
     return f"{actor}: {', '.join(moves) or ev.get('action', '?')} " \
            f"(rule {rule})"
 

@@ -405,13 +405,24 @@ class SLOMonitor:
     return {f"{name}@{key}": ("breach" if st["breached"] else "ok")
             for (name, key), st in self._state.items()}
 
-  def breached_streams(self) -> List[Tuple[str, str]]:
+  def breached_streams(self, scope: Optional[str] = None
+                       ) -> List[Tuple[str, str]]:
     """Currently-breached ``(rule_name, metric_key)`` streams — the
     live-pressure view actuators poll between steps (a breach EVENT
     fires only on the transition; sustained overload looks like a
-    stream that stays breached, serving/autotune.py)."""
-    return [(name, key) for (name, key), st in self._state.items()
-            if st["breached"]]
+    stream that stays breached, serving/autotune.py).
+
+    ``scope`` restricts the view to streams whose metric key lives
+    under that namespace prefix (``key == scope`` or starts with
+    ``scope + "/"``) — how the rollout controller watches ONLY the
+    canary's per-version streams (``serving/fleet/v<N>/...``) while the
+    fleet-wide streams keep feeding the autoscaler."""
+    out = [(name, key) for (name, key), st in self._state.items()
+           if st["breached"]]
+    if scope is not None:
+      out = [(name, key) for name, key in out
+             if key == scope or key.startswith(scope + "/")]
+    return out
 
   def breached_stream_obs(self) -> Dict[Tuple[str, str], int]:
     """Observation counts for the currently-breached streams: how many

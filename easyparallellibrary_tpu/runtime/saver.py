@@ -104,6 +104,49 @@ def _write_index(directory: str, index: Dict[str, Any]):
   os.replace(tmp, final)
 
 
+def params_fingerprint(index: Dict[str, Any]) -> str:
+  """Deterministic content fingerprint of a checkpoint from its index:
+  sha256 over the sorted leaf records (path, stored shape, dtype) plus
+  each leaf's covering shard checksum — i.e. tree structure + geometry
+  + a per-shard sha256 rollup in one digest.
+
+  Recorded in ``index.json`` at save time (``"params_fingerprint"``)
+  and recomputed by :func:`verify_checkpoint`, so a hand-edited or
+  mix-and-matched index (leaves of one save over shards of another —
+  per-shard checksums alone cannot catch that) is rejected with a
+  clear reason.  The rollout validator (serving/rollout.py) also uses
+  it as the checkpoint's identity: two directories with the same
+  fingerprint serve bit-identical params."""
+  h = hashlib.sha256()
+  shard_digest: Dict[str, str] = {}
+  for entry in index.get("shards", []):
+    if isinstance(entry, dict):
+      shard_digest[str(entry.get("file", ""))] = str(
+          entry.get("sha256") or "")
+  for path in sorted(index.get("leaves", {})):
+    info = index["leaves"][path]
+    h.update(path.encode())
+    h.update(repr(tuple(info.get("shape", ()))).encode())
+    h.update(str(info.get("dtype", "")).encode())
+    h.update(shard_digest.get(str(info.get("shard", "")), "").encode())
+    h.update(b"\x00")
+  return h.hexdigest()
+
+
+def checkpoint_fingerprint(directory: str) -> Tuple[str, int]:
+  """``(fingerprint, step)`` of the newest VALID checkpoint under
+  ``directory`` — the recorded index fingerprint when present, else
+  computed from the index (pre-fingerprint saves).  Walks the same
+  checksum-validated chain as every other reader, so the identity
+  describes the checkpoint a restore would actually load."""
+  for path in _walk_valid_checkpoints(directory):
+    with open(os.path.join(path, INDEX_FILE)) as f:
+      index = json.load(f)
+    fp = index.get("params_fingerprint") or params_fingerprint(index)
+    return str(fp), int(index.get("step") or 0)
+  raise FileNotFoundError(f"no valid checkpoint under {directory!r}")
+
+
 def _candidate_dirs(directory: str) -> List[str]:
   """Checkpoint candidates, newest first.
 
@@ -186,6 +229,13 @@ def verify_checkpoint(path: str) -> Tuple[bool, str]:
     # retention-pruned the dir under us) is just another way for the
     # candidate to be invalid — the chain must fall back, not crash.
     return False, f"shard disappeared during validation ({e})"
+  recorded = index.get("params_fingerprint")
+  if recorded is not None and recorded != params_fingerprint(index):
+    # The per-shard checksums above prove each shard matches ITS index
+    # entry; the fingerprint proves the index entries belong together —
+    # a leaves table edited (or mixed with another save's shard list)
+    # after the fact fails here, not as a wrong-weights decode.
+    return False, "params fingerprint mismatch (index edited or mixed)"
   return True, ""
 
 
@@ -389,6 +439,7 @@ def save_checkpoint(directory: str, tree, step: Optional[int] = None,
       bucket_bytes += nbytes
     flush()
     if is_leader:
+      index["params_fingerprint"] = params_fingerprint(index)
       retry_call(lambda: _write_index(write_dir, index),
                  what="checkpoint index write")
       _fsync_path(write_dir, is_dir=True)

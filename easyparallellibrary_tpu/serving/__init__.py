@@ -14,8 +14,10 @@ health-checked :class:`Router` with bit-exact failover, graceful
 drain/rejoin and prefix-affinity dispatch (:mod:`router`) — and
 fleet-wide copy-on-write prefix caching: a content-addressed radix tree
 over prompt blocks that maps shared KV by reference at admission and
-persists session prefixes across requests (:mod:`prefix_cache`).  See
-docs/serving.md and docs/robustness.md.
+persists session prefixes across requests (:mod:`prefix_cache`) — and
+zero-downtime blue/green checkpoint rollout with an SLO-watched canary
+and automatic rollback (:mod:`rollout`).  See docs/serving.md and
+docs/robustness.md.
 """
 
 from easyparallellibrary_tpu.serving._capabilities import (
@@ -33,6 +35,7 @@ from easyparallellibrary_tpu.serving.autotune import (
     TUNE_LEVELS, EngineAutotuner,
 )
 from easyparallellibrary_tpu.serving.replica import EngineReplica
+from easyparallellibrary_tpu.serving.rollout import RolloutController
 from easyparallellibrary_tpu.serving.router import Router
 from easyparallellibrary_tpu.serving.transport import (
     InprocTransport, ProcessTransport, RemoteError, ReplicaDeadError,
@@ -67,7 +70,8 @@ __all__ = [
     "AdmissionController", "BadStepPolicy", "DEGRADE_LEVELS",
     "FINISH_REASONS", "PRIORITIES",
     "EngineReplica", "HEALTH_STATES", "ReplicaHealth", "Router",
-    "EngineAutotuner", "FleetAutoscaler", "TUNE_LEVELS",
+    "EngineAutotuner", "FleetAutoscaler", "RolloutController",
+    "TUNE_LEVELS",
     "InprocTransport", "ProcessTransport", "RemoteError", "ReplicaDeadError",
     "ReplicaTransport", "TransportError", "TransportTimeout",
     "Drafter", "DraftModelDrafter", "NgramDrafter", "ngram_propose",

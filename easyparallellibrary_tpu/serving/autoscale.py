@@ -119,6 +119,13 @@ class FleetAutoscaler:
     self._spawn_queue = None
     self._spawn_busy = False
     self._spawn_outcome: Optional[tuple] = None
+    # External hold (serving/rollout.py): while a blue/green rollout is
+    # in flight the replica set belongs to the rollout controller —
+    # autoscale grow/shrink during a canary would change the capacity
+    # the canary's SLO evidence is judging.  In-flight spawn outcomes
+    # still LAND while held (a child process must be adopted or
+    # reaped), but no new action starts.
+    self._hold_reason: Optional[str] = None
     monitor = router._slo
     from easyparallellibrary_tpu.observability.slo import BreachPressure
     self._probe = BreachPressure(
@@ -195,6 +202,25 @@ class FleetAutoscaler:
     with self._lock:
       return self._spawn_busy or self._spawn_outcome is not None
 
+  def hold(self, reason: str) -> None:
+    """Suspend autoscaling actions (init comment on ``_hold_reason``):
+    breaches keep being recorded and in-flight spawns still land, but
+    no grow/shrink starts until :meth:`release`.  Idempotent."""
+    if self._hold_reason is None:
+      get_logger().info("autoscale: held (%s)", reason)
+    self._hold_reason = reason
+
+  def release(self) -> None:
+    """Lift a :meth:`hold`.  Idempotent."""
+    if self._hold_reason is not None:
+      get_logger().info("autoscale: released (was held: %s)",
+                        self._hold_reason)
+    self._hold_reason = None
+
+  @property
+  def held(self) -> bool:
+    return self._hold_reason is not None
+
   def scale_up_holdout_s(self) -> float:
     """Current scale-up hold-out: the base cooldown doubled per flap
     trip (capped) — PR 8's breaker shape applied to capacity."""
@@ -219,6 +245,16 @@ class FleetAutoscaler:
       # breach could silently revert it.
       self._parked = [i for i in self._parked
                       if self.router.health[i].state == "draining"]
+    if self._hold_reason is not None:
+      # Held (rollout in flight): the breach event is consumed as a
+      # hold — a burn that OUTLIVES the hold re-fires through the
+      # sustained-pressure poll once released, so no real overload is
+      # lost, only the stale event.
+      with self._lock:
+        pending, self._pending_rule = self._pending_rule, None
+      if pending is not None:
+        self.holds += 1
+      return
     with self._lock:
       rule, self._pending_rule = self._pending_rule, None
     if rule is not None:

@@ -209,7 +209,8 @@ class ContinuousBatchingEngine:
                token_budget: Optional[int] = None,
                prefix_cache: Optional[bool] = None,
                stats=None, metrics_writer=None, registry=None,
-               config=None, track_prefix: Optional[str] = None):
+               config=None, track_prefix: Optional[str] = None,
+               checkpoint_version: int = 0):
     cfg = model.cfg
     root_config = config if config is not None else Env.get().config
     conf = root_config.serving
@@ -239,6 +240,11 @@ class ContinuousBatchingEngine:
     self.model = model
     self.params = params
     self.mesh = _resolve_mesh(mesh)
+    # The checkpoint version these params came from (blue/green rollout,
+    # serving/rollout.py): scopes the prefix cache's keys and makes the
+    # scheduler refuse cross-version restore replays.  0 = pre-rollout
+    # default; the rollout controller stamps green replicas with N+1.
+    self.checkpoint_version = int(checkpoint_version)
     self.num_slots = num_slots if num_slots is not None else conf.num_slots
     self.chunk = (prefill_chunk if prefill_chunk is not None
                   else conf.prefill_chunk)
@@ -302,7 +308,8 @@ class ContinuousBatchingEngine:
         track_prefix=self._track_prefix,
         prefix_cache=self.prefix_caching,
         prefix_session_ttl_s=pc_conf.session_ttl_s,
-        prefix_max_cached_blocks=pc_conf.max_cached_blocks)
+        prefix_max_cached_blocks=pc_conf.max_cached_blocks,
+        checkpoint_version=self.checkpoint_version)
     res_conf = conf.resilience
     self._resilient = (resilience if resilience is not None
                        else res_conf.enabled)

@@ -83,8 +83,18 @@ _BLOCK_SALT = zlib.crc32(b"epl/prefix/block")
 _SHORT_SALT = zlib.crc32(b"epl/prefix/short")
 
 
+def _version_salt(base: int, version: int) -> int:
+  """Fold a checkpoint version into a chain seed.  Version 0 (the
+  pre-rollout default) keeps the bare salt, so single-version fleets
+  hash byte-identically to every build before versioning existed."""
+  if version == 0:
+    return base
+  return zlib.crc32(np.asarray([version], np.int64).tobytes(), base)
+
+
 def block_prefix_keys(prompt, block_size: int,
-                      max_blocks: int = AFFINITY_MAX_BLOCKS) -> List[int]:
+                      max_blocks: int = AFFINITY_MAX_BLOCKS,
+                      version: int = 0) -> List[int]:
   """Content keys for every block-aligned prefix depth of ``prompt``,
   shallowest first — the SHARED hashing between the radix tree's block
   granularity and the router's affinity map (router.py).
@@ -98,17 +108,24 @@ def block_prefix_keys(prompt, block_size: int,
   a distinct salt, preserving exact-duplicate affinity for tiny
   prompts.  Deterministic and process-stable (crc32, not Python's
   salted ``hash``), like every other cross-replica key in serving/.
+
+  ``version`` scopes every key to a checkpoint version (blue/green
+  rollout, serving/rollout.py): the same prompt under version N and
+  N+1 yields DISJOINT keys, so the router's affinity map never sends a
+  green-pinned request to the replica that warmed this prefix under
+  blue weights.  Version 0 hashes identically to the unversioned past.
   """
   prompt = np.ascontiguousarray(np.asarray(prompt, np.int32).reshape(-1))
   full = max(0, int(prompt.size) - 1) // block_size if block_size > 0 else 0
   keys: List[int] = []
-  crc = _BLOCK_SALT
+  crc = _version_salt(_BLOCK_SALT, version)
   for d in range(min(full, max_blocks)):
     crc = zlib.crc32(prompt[d * block_size:(d + 1) * block_size].tobytes(),
                      crc)
     keys.append(crc)
   if not keys:
-    keys.append(zlib.crc32(prompt.tobytes(), _SHORT_SALT))
+    keys.append(zlib.crc32(prompt.tobytes(),
+                           _version_salt(_SHORT_SALT, version)))
   return keys
 
 
@@ -146,7 +163,8 @@ class PrefixCache:
 
   def __init__(self, allocator: BlockAllocator, block_size: int,
                session_ttl_s: float = 0.0, max_cached_blocks: int = 0,
-               clock: Callable[[], float] = time.monotonic):
+               clock: Callable[[], float] = time.monotonic,
+               version: int = 0):
     if block_size < 1:
       raise ValueError(f"block_size must be >= 1: {block_size}")
     if session_ttl_s < 0:
@@ -159,6 +177,15 @@ class PrefixCache:
     self.session_ttl_s = session_ttl_s
     self.max_cached_blocks = max_cached_blocks
     self.clock = clock
+    # Checkpoint-version isolation (blue/green rollout): depth-0 keys
+    # carry a version tag, so K/V cached under checkpoint N can NEVER
+    # satisfy a match under N+1 — identical tokens under different
+    # weights are different content (silent wrong-weights reuse would
+    # be a correctness bug the moment two versions coexist).  Version 0
+    # keeps empty-tag keys, byte-identical to the unversioned past.
+    self.version = int(version)
+    self._vtag = (b"" if self.version == 0
+                  else b"v%d:" % self.version)
     self._root = _Node(b"", NULL_BLOCK, None, 0.0)  # sentinel, no block
     # Insertion/touch-ordered node registry: front = least recent.  The
     # deepest-first path-touch discipline (module docstring) keeps the
@@ -210,7 +237,10 @@ class PrefixCache:
     limit = max(0, int(prefix.size) - 1) // bs
     node, path = self._root, []
     for d in range(limit):
-      child = node.children.get(prefix[d * bs:(d + 1) * bs].tobytes())
+      key = prefix[d * bs:(d + 1) * bs].tobytes()
+      if d == 0:
+        key = self._vtag + key  # version-scoped root fan-out
+      child = node.children.get(key)
       if child is None:
         break
       path.append(child)
@@ -245,6 +275,8 @@ class PrefixCache:
     now = self.clock()
     for d in range(num_blocks):
       key = tokens[d * bs:(d + 1) * bs].tobytes()
+      if d == 0:
+        key = self._vtag + key  # version-scoped root fan-out
       child = node.children.get(key)
       if child is None:
         blk = blocks[d]

@@ -147,6 +147,14 @@ class EngineReplica:
     least-loaded dispatch key."""
     return self.num_active + self.queue_depth
 
+  @property
+  def checkpoint_version(self) -> int:
+    """The checkpoint version this replica's params came from
+    (blue/green rollout, serving/rollout.py; 0 pre-rollout).  The
+    router reads it for version-aware dispatch and version-gated
+    failover placement."""
+    return self.engine.checkpoint_version
+
   # ------------------------------------------------------ health signals
 
   @property
@@ -241,6 +249,7 @@ class _WorkerServer:
         "load": int(rep.load),
         "has_work": bool(rep.has_work),
         "compiles": compiles,
+        "checkpoint_version": int(rep.checkpoint_version),
         "pid": os.getpid(),
     }
 
@@ -262,6 +271,16 @@ class _WorkerServer:
     epl.init(config)
     fn, kwargs = self._t.resolve_factory(p["factory"])
     model, params = fn(**kwargs)
+    checkpoint = p.get("checkpoint")
+    if checkpoint:
+      # Blue/green rollout (serving/rollout.py): this child serves a
+      # SPECIFIC checkpoint, not the factory's params.  restore_params
+      # walks the checksum-validated chain and verifies the stored
+      # params fingerprint/geometry against the factory tree, so a
+      # half-written or mismatched checkpoint fails the init RPC with a
+      # clear error instead of an XLA shape crash mid-decode.
+      from easyparallellibrary_tpu.runtime.saver import restore_params
+      params, _ = restore_params(checkpoint, target=params)
     self.replica = EngineReplica(
         int(p.get("index", 0)), model, params, config=config,
         **(p.get("engine_kwargs") or {}))

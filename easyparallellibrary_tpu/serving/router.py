@@ -201,6 +201,17 @@ class Router:
     self._parked: List[Dict[str, Any]] = []
     self._affinity: "OrderedDict[int, int]" = OrderedDict()
     self._rr = 0                     # round-robin cursor
+    # Blue/green rollout state (serving/rollout.py).  `None` weights =
+    # version-blind dispatch, byte-for-byte the pre-rollout behavior;
+    # during a rollout the controller sets {version: admission_weight}
+    # and _choose splits NEW admissions by a deterministic deficit
+    # counter (no RNG — replayable).  `_fleet_version` is the version
+    # the steady-state fleet serves: it salts affinity digests so a
+    # warm-prefix hint can never route a request onto a replica whose
+    # cache was filled by different weights.
+    self._version_weights: Optional[Dict[int, float]] = None
+    self._version_dispatched: Dict[int, int] = {}
+    self._fleet_version = int(engine_kwargs.get("checkpoint_version", 0))
     self._drain_deadline: Dict[int, float] = {}
     self._rejoined_at: Dict[int, float] = {}
     self.steps = 0
@@ -217,6 +228,15 @@ class Router:
       from easyparallellibrary_tpu.serving.autoscale import (
           FleetAutoscaler)
       self._autoscaler = FleetAutoscaler(self, config=root_config)
+    # Blue/green checkpoint rollout controller (serving/rollout.py):
+    # operator calls router.rollout.begin(checkpoint_dir); all state
+    # transitions happen in on_step at sweep boundaries, same contract
+    # as the autoscaler.
+    self.rollout = None
+    if root_config.serving.rollout.enabled:
+      from easyparallellibrary_tpu.serving.rollout import (
+          RolloutController)
+      self.rollout = RolloutController(self, config=root_config)
     get_logger().info(
         "serving router: %d replica(s), suspect/down after %.1fs/%.1fs, "
         "drain timeout %.1fs, affinity %s", len(self.replicas),
@@ -239,7 +259,10 @@ class Router:
     keys off this to fall back to the synchronous lever."""
     return self._replica_spec is not None
 
-  def build_replica(self, index: Optional[int] = None):
+  def build_replica(self, index: Optional[int] = None, *,
+                    checkpoint: Optional[str] = None,
+                    checkpoint_version: Optional[int] = None,
+                    params=None):
     """Construct ONE new replica from the stored recipe WITHOUT
     registering it — the slow half of :meth:`add_replica` (a process
     transport's subprocess spawn + in-child compile), split out so the
@@ -248,7 +271,13 @@ class Router:
     routing until :meth:`adopt_replica` lands it on the router thread.
 
     Thread-safety contract: this method only READS the recipe (and
-    spawns); it never touches the replica/health lists."""
+    spawns); it never touches the replica/health lists.
+
+    ``checkpoint``/``checkpoint_version``/``params`` override the
+    recipe for ONE build — the rollout controller's green-spawn lever
+    (serving/rollout.py).  A completed rollout instead rewrites the
+    recipe itself, so later autoscale spawns and breaker respawns serve
+    the new version with no override."""
     if self._replica_spec is None:
       raise RuntimeError(
           "build_replica() needs a router that built its own replicas; "
@@ -256,14 +285,21 @@ class Router:
           "(model, params)/factory recipe to grow from")
     spec = self._replica_spec
     index = len(self.replicas) if index is None else index
+    kwargs = dict(spec["engine_kwargs"])
+    if checkpoint_version is not None:
+      kwargs["checkpoint_version"] = int(checkpoint_version)
     if self.transport == "process":
+      ckpt = checkpoint if checkpoint is not None else (
+          spec.get("checkpoint"))
       return ProcessTransport(
           index, spec["factory"], config=self._root_config,
-          engine_kwargs=spec["engine_kwargs"])
+          engine_kwargs=kwargs, checkpoint=ckpt)
     return InprocTransport(
-        index, spec["model"], spec["params"], mesh=spec["mesh"],
+        index, spec["model"],
+        spec["params"] if params is None else params,
+        mesh=spec["mesh"],
         registry=spec["registry"], config=self._root_config,
-        **spec["engine_kwargs"])
+        **kwargs)
 
   def adopt_replica(self, rep) -> int:
     """Register a built replica with the fleet (the fast half of
@@ -321,12 +357,60 @@ class Router:
 
   # ----------------------------------------------------------- dispatch
 
-  def _prefix_keys(self, prompt: np.ndarray) -> List[int]:
+  def _replica_version(self, index: int) -> int:
+    """Checkpoint version replica ``index`` serves (0 = unversioned —
+    injected test replicas and pre-rollout fleets)."""
+    return int(getattr(self.replicas[index], "checkpoint_version", 0)
+               or 0)
+
+  def set_version_weights(self,
+                          weights: Optional[Dict[int, float]]) -> None:
+    """Install per-checkpoint-version admission weights (the rollout
+    controller's lever; init comment on ``_version_weights``).  Resets
+    the deficit counters so each stage's split is exact from its first
+    admission; ``None`` restores version-blind dispatch."""
+    if weights is None:
+      self._version_weights = None
+      self._version_dispatched = {}
+      return
+    self._version_weights = {int(v): float(w)
+                             for v, w in weights.items() if w > 0.0}
+    self._version_dispatched = {v: 0 for v in self._version_weights}
+
+  def _pick_version(self, routable: List[int]) -> tuple:
+    """Deterministic weighted split of NEW admissions across checkpoint
+    versions: pick the version with the largest admission deficit
+    (expected share minus actual dispatches — no RNG, so a replayed
+    trace splits identically), restricted to versions with a routable
+    replica.  Returns ``(version, candidates)``; falls back to the
+    whole routable set when no weighted version is live (weights must
+    degrade, never shed)."""
+    by_ver: Dict[int, List[int]] = {}
+    for i in routable:
+      by_ver.setdefault(self._replica_version(i), []).append(i)
+    weights = {v: w for v, w in self._version_weights.items()
+               if v in by_ver}
+    if not weights:
+      return None, routable
+    total = sum(weights.values())
+    n = sum(self._version_dispatched.get(v, 0) for v in weights) + 1
+    best = max(sorted(weights),
+               key=lambda v: (weights[v] / total) * n
+               - self._version_dispatched.get(v, 0))
+    self._version_dispatched[best] = (
+        self._version_dispatched.get(best, 0) + 1)
+    return best, by_ver[best]
+
+  def _prefix_keys(self, prompt: np.ndarray,
+                   version: Optional[int] = None) -> List[int]:
     """Block-aligned content keys for ``prompt``, shallowest first —
     the SAME hashing the prefix cache's radix tree matches at
     (prefix_cache.block_prefix_keys), so a deep affinity hit predicts a
-    deep block-reuse hit on the target replica."""
-    return block_prefix_keys(prompt, self._affinity_block)
+    deep block-reuse hit on the target replica.  Keys are salted with
+    the serving checkpoint version (default: the steady-state fleet's)
+    so blue-era affinity entries can never name a green replica."""
+    ver = self._fleet_version if version is None else int(version)
+    return block_prefix_keys(prompt, self._affinity_block, version=ver)
 
   def _remember_affinity(self, key: int, index: int) -> None:
     self._affinity.pop(key, None)
@@ -350,6 +434,11 @@ class Router:
     routable = self._routable()
     if not routable:
       return None, "no_replica"
+    version: Optional[int] = None
+    if self._version_weights is not None:
+      # Rollout in flight: the admission-weight split picks the
+      # checkpoint version FIRST, then normal dispatch ranks within it.
+      version, routable = self._pick_version(routable)
     if len(routable) == 1:
       return routable[0], "only"
     if any(self.health[i].signals_stale(now) for i in routable):
@@ -360,7 +449,7 @@ class Router:
     if self._affinity_enabled:
       # Deepest matching depth first: the longest shared block-aligned
       # prefix names the replica holding the most of this prompt warm.
-      for key in reversed(self._prefix_keys(prompt)):
+      for key in reversed(self._prefix_keys(prompt, version)):
         aff = self._affinity.get(key)
         if (aff is not None and aff in routable
             and self.replicas[aff].load < self.replicas[aff].num_slots):
@@ -422,6 +511,15 @@ class Router:
             "serving/route", cat="serving", track="serving/requests",
             args={"uid": str(request.uid), "replica": idx,
                   "reason": reason})
+      # Pin the request to the checkpoint version it is admitted under:
+      # the tag rides every snapshot, so a later failover can only
+      # replay it onto a SAME-version survivor (prefix replay across
+      # versions is not bit-exact — docs/robustness.md, migration
+      # policy complete-in-place).
+      version = self._replica_version(idx)
+      if request.checkpoint_version != version:
+        request = dataclasses.replace(request,
+                                      checkpoint_version=version)
       try:
         accepted = self.replicas[idx].submit(request)
       except TransportError as e:
@@ -444,8 +542,10 @@ class Router:
         if self._affinity_enabled:
           # Every depth remembers the placement: a future prompt
           # sharing only a SHALLOWER block-aligned prefix still finds
-          # the warm replica through its own deepest common key.
-          for key in self._prefix_keys(prompt):
+          # the warm replica through its own deepest common key.  Keys
+          # carry the target's version salt, so the hint only ever
+          # matches lookups routed to that same version.
+          for key in self._prefix_keys(prompt, version):
             self._remember_affinity(key, idx)
       else:
         # The replica's admission control shed it and recorded the
@@ -521,6 +621,11 @@ class Router:
     retirements fleet-wide."""
     now = self.clock()
     out: List[FinishedRequest] = []
+    if self.rollout is not None:
+      # Rollout transitions land BEFORE the autoscaler acts: a rollback
+      # or cutover this sweep must hold/release the autoscaler before
+      # it reads the replica set (serving/rollout.py).
+      self.rollout.on_step(now)
     if self._autoscaler is not None:
       # Replica-set actuation happens HERE, before the sweep touches
       # the list — a mid-sweep grow/drain would race the phase loops.
@@ -593,17 +698,24 @@ class Router:
 
   def _publish_rollup(self) -> None:
     self._last_rollup = self.clock()
-    rollup = self.fleet_summary()
-    if self.registry is not None:
-      # The SLO monitor rides the registry as a sink (attach at init).
-      self.registry.publish(self.steps, rollup, FLEET_NAMESPACE)
-    elif self._slo is not None:
-      # Registry-less fleet: same validated schema helper the registry
-      # path uses — never an ad-hoc key literal (namespaced() validates
-      # the root; report.py reads back through the same constant).
-      self._slo.observe(self.steps,
-                        MetricRegistry.namespaced(FLEET_NAMESPACE,
-                                                  rollup))
+    records = [(FLEET_NAMESPACE, self.fleet_summary())]
+    if self.rollout is not None and self.rollout.active:
+      # Per-version sub-rollups during a rollout (serving/rollout.py):
+      # the SLO monitor's bare-name rules suffix-match these keys, so
+      # the canary's evidence streams (``serving/fleet/v<N>/...``)
+      # exist exactly while a rollout is in flight, with no new rules.
+      for ver, sub in self.rollout.version_rollups().items():
+        records.append((f"{FLEET_NAMESPACE}/v{ver}", sub))
+    for namespace, rollup in records:
+      if self.registry is not None:
+        # The SLO monitor rides the registry as a sink (attach at init).
+        self.registry.publish(self.steps, rollup, namespace)
+      elif self._slo is not None:
+        # Registry-less fleet: same validated schema helper the registry
+        # path uses — never an ad-hoc key literal (namespaced() validates
+        # the root; report.py reads back through the same constant).
+        self._slo.observe(self.steps,
+                          MetricRegistry.namespaced(namespace, rollup))
 
   def _reap(self, now: float) -> None:
     """Fail over any down replica still holding requests.  Idempotent —
@@ -626,12 +738,15 @@ class Router:
       for fin in self.step():
         out[fin.uid] = fin.tokens
       steps += 1
-      if (self._parked and not self._survivors(-1)
+      if (self._parked
           and not any(rep.has_work
                       for i, rep in enumerate(self.replicas)
-                      if self.health[i].state != "down")):
-        # The parked backlog cannot move (no healthy or suspect target)
-        # and no live replica has work of its own to make progress on —
+                      if self.health[i].state != "down")
+          and not any(self._eligible_targets(s, self._survivors(-1))
+                      for s in self._parked)):
+        # The parked backlog cannot move (no healthy or suspect target
+        # — or none of the pinned version) and no live replica has work
+        # of its own to make progress on —
         # return instead of spinning; the backlog is preserved and a
         # later run()/step() resumes it after a breaker probe or an
         # operator rejoin().
@@ -693,6 +808,17 @@ class Router:
     return [i for i, h in enumerate(self.health)
             if h.state == "suspect" and i != exclude]
 
+  def _eligible_targets(self, snap: Dict[str, Any],
+                        targets: List[int]) -> List[int]:
+    """Targets a snapshot may restore onto: all of them for an unpinned
+    request, only SAME-version replicas for one pinned to a checkpoint
+    version (_place_snapshots docstring)."""
+    pinned = snap["request"].get("checkpoint_version")
+    if pinned is None:
+      return list(targets)
+    return [i for i in targets
+            if self._replica_version(i) == int(pinned)]
+
   def _place_snapshots(self, snaps: List[Dict[str, Any]],
                        targets: List[int]) -> int:
     """Distribute snapshots over ``targets`` (least-loaded each time,
@@ -705,7 +831,13 @@ class Router:
     target is dropped and marked down, an AMBIGUOUSLY-applied restore
     (the target's transport journaled it before the wire failed) stays
     placed there — its own failover recovers it, double-placing would
-    fork the request — and when no target is left the remainder parks."""
+    fork the request — and when no target is left the remainder parks.
+
+    A snapshot pinned to a checkpoint version only places on a
+    SAME-version target (migration policy complete-in-place,
+    docs/robustness.md): mid-rollout, a dead blue's requests fail over
+    to a surviving blue, never green — and with no same-version target
+    they park (delayed, never replayed across versions)."""
     placed = 0
     targets = list(targets)
     pending = list(snaps)
@@ -717,7 +849,16 @@ class Router:
         self._parked.extend(pending)
         break
       snap = pending[-1]
-      idx = min(targets, key=lambda i: (self.replicas[i].load, i))
+      eligible = self._eligible_targets(snap, targets)
+      if not eligible:
+        get_logger().warning(
+            "no version-%s target for request %r: parking (cross-"
+            "version replay is refused)",
+            snap["request"].get("checkpoint_version"),
+            snap["request"].get("uid"))
+        self._parked.append(pending.pop())
+        continue
+      idx = min(eligible, key=lambda i: (self.replicas[i].load, i))
       try:
         uid = self.replicas[idx].restore_request(snap, front=True)
       except Exception as e:  # noqa: BLE001 — target died mid-restore
@@ -789,10 +930,18 @@ class Router:
     targets = self._survivors(-1)
     if not targets:
       return
-    snaps, self._parked = self._parked, []
-    self._place_snapshots(snaps, targets)
+    # A version-pinned snapshot with no same-version target stays
+    # parked QUIETLY (no per-step churn through _place_snapshots);
+    # it moves the moment its version has a live replica again.
+    movable = [s for s in self._parked
+               if self._eligible_targets(s, targets)]
+    if not movable:
+      return
+    moved = {id(s) for s in movable}
+    self._parked = [s for s in self._parked if id(s) not in moved]
+    self._place_snapshots(movable, targets)
     get_logger().info("flushed %d parked request(s) onto replica(s) %s",
-                      len(snaps), targets)
+                      len(movable), targets)
 
   def _probe(self, index: int) -> None:
     """Half-open breaker probe: the cooldown elapsed, let the replica
@@ -856,6 +1005,16 @@ class Router:
         del self._drain_deadline[index]
         continue
       if now < self._drain_deadline[index]:
+        continue
+      targets = self._survivors(index)
+      if targets and not any(
+          self._replica_version(t) == self._replica_version(index)
+          for t in targets):
+        # Complete-in-place (docs/robustness.md): survivors exist but
+        # none serves this replica's checkpoint version, so evacuating
+        # would only park its (version-pinned) requests — a LIVE
+        # draining replica keeps serving them to completion instead.
+        self._drain_deadline[index] = now + self._drain_timeout_s
         continue
       del self._drain_deadline[index]
       snaps = rep.evacuate()
@@ -926,6 +1085,9 @@ class Router:
       # Actuator counters ride the same fleet rollup (scale_ups,
       # scale_downs, autoscale_holds, flap_trips).
       counters.update(self._autoscaler.counters())
+    if self.rollout is not None:
+      # rollout_* counters (serving/rollout.py) ride the same schema.
+      counters.update(self.rollout.counters())
     for rep in self.replicas:
       rpc = getattr(rep, "rpc_counters", None)
       if rpc is None:

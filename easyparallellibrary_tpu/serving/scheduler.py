@@ -80,8 +80,10 @@ def next_flow_id() -> int:
 # Request.snapshot() wire-format version: bump on ANY field change and
 # keep a reader for every prior version — snapshots cross process
 # boundaries (transport RPC, crash journals) where writer and reader
-# can be different builds.
-SNAPSHOT_VERSION = 1
+# can be different builds.  v2 added ``checkpoint_version`` (blue/green
+# rollout, serving/rollout.py); v1 snapshots read with it defaulted to
+# None ("any version" — pre-rollout fleets have exactly one).
+SNAPSHOT_VERSION = 2
 
 
 def _request_key(req: "Request") -> np.ndarray:
@@ -125,6 +127,14 @@ class Request:
   docs/observability.md "Request-flow correlation") — minted
   automatically at the first submit (router or scheduler) and carried
   through snapshot/restore, so callers never set it.
+
+  ``checkpoint_version`` pins the request to the weights it started
+  decoding under (docs/robustness.md "Blue/green rollout"): stamped by
+  the router at dispatch time from the chosen replica's version and
+  carried through snapshot/restore, so the failover journal can refuse
+  to replay it onto a replica of a DIFFERENT version — prefix replay
+  across checkpoints is not bit-exact.  ``None`` means "any version"
+  (single-version fleets, pre-rollout snapshots); callers never set it.
   """
   uid: Any
   prompt: np.ndarray
@@ -139,6 +149,7 @@ class Request:
   ttft_budget_s: float = 0.0
   priority: str = "throughput"
   flow_id: Optional[int] = None
+  checkpoint_version: Optional[int] = None
 
   def snapshot(self) -> Dict[str, Any]:
     """JSON-serializable snapshot of the request spec (the immutable
@@ -150,12 +161,14 @@ class Request:
     token index, so prompt + generated prefix IS the full sampler
     state.
 
-    The dict is **versioned** (``"v": 1``): snapshots cross process
+    The dict is **versioned** (``"v": 2``): snapshots cross process
     boundaries (serving/transport.py ships them to worker processes and
     journals them for crash recovery), so a future field change must
-    bump the version and keep a reader for v1 — :meth:`restore` rejects
-    unknown versions with a clear error instead of mis-restoring, and
-    tests/golden/request_snapshot_v1.json pins the exact v1 shape."""
+    bump the version and keep a reader for every prior one —
+    :meth:`restore` rejects unknown versions with a clear error instead
+    of mis-restoring, and tests/golden/request_snapshot_v{1,2}.json pin
+    the exact shapes.  v2 added ``checkpoint_version``; a v1 snapshot
+    reads with it defaulted to None."""
     return {
         "v": SNAPSHOT_VERSION,
         "uid": self.uid,
@@ -171,23 +184,28 @@ class Request:
         "ttft_budget_s": float(self.ttft_budget_s),
         "priority": self.priority,
         "flow_id": None if self.flow_id is None else int(self.flow_id),
+        "checkpoint_version": (None if self.checkpoint_version is None
+                               else int(self.checkpoint_version)),
     }
 
   @classmethod
   def restore(cls, snap: Dict[str, Any]) -> "Request":
     """Inverse of :meth:`snapshot` (tolerates a JSON round trip).
-    Pre-versioning snapshots (no ``"v"`` key) read as v1 — the field
-    set is identical; an UNKNOWN version is rejected loudly, because
-    silently dropping or misreading a field would break cross-process
-    failover bit-exactness in the quietest possible way."""
+    Pre-versioning snapshots (no ``"v"`` key) read as v1 — the v1 field
+    set with ``checkpoint_version`` absent; a v1 snapshot restores with
+    it defaulted to None ("any version").  An UNKNOWN (newer) version
+    is rejected loudly, because silently dropping or misreading a field
+    would break cross-process failover bit-exactness in the quietest
+    possible way."""
     snap = dict(snap)
-    version = snap.pop("v", SNAPSHOT_VERSION)
-    if version != SNAPSHOT_VERSION:
+    version = snap.pop("v", 1)
+    if not 1 <= version <= SNAPSHOT_VERSION:
       raise ValueError(
           f"unsupported request snapshot version {version!r}: this build "
-          f"reads v{SNAPSHOT_VERSION} (a newer writer must not feed an "
-          f"older reader across the failover wire — upgrade the reader "
-          f"or re-snapshot with a v{SNAPSHOT_VERSION} writer)")
+          f"reads v1..v{SNAPSHOT_VERSION} (a newer writer must not feed "
+          f"an older reader across the failover wire — upgrade the "
+          f"reader or re-snapshot with a v{SNAPSHOT_VERSION} writer)")
+    snap.setdefault("checkpoint_version", None)
     snap["prompt"] = np.asarray(snap["prompt"], np.int32)
     return cls(**snap)
 
@@ -361,7 +379,8 @@ class FCFSScheduler:
                token_budget: int = 0, track_prefix: str = "serving",
                prefix_cache: bool = False,
                prefix_session_ttl_s: float = 0.0,
-               prefix_max_cached_blocks: int = 0):
+               prefix_max_cached_blocks: int = 0,
+               checkpoint_version: int = 0):
     from easyparallellibrary_tpu.serving.kv_cache import (
         BlockAllocator, SlotAllocator)
     from easyparallellibrary_tpu.serving.prefix_cache import PrefixCache
@@ -374,6 +393,13 @@ class FCFSScheduler:
     self.num_slots = num_slots
     self.chunk = prefill_chunk
     self.max_seq_len = max_seq_len
+    # The checkpoint version this scheduler's engine serves
+    # (docs/robustness.md "Blue/green rollout"): restore_request refuses
+    # a snapshot pinned to a DIFFERENT version — prefix replay across
+    # weights is not bit-exact — and the prefix cache keys its radix
+    # tree on it so a warm block from checkpoint N is never reused to
+    # skip prefill under N+1.  0 is the pre-rollout default.
+    self.checkpoint_version = int(checkpoint_version)
     # Paged mode (block_size > 0): plan_step builds token-flat
     # PagedStepPlans against a block-table cache; the per-slot K/V
     # region becomes a grown-on-demand block list and pool exhaustion
@@ -411,7 +437,7 @@ class FCFSScheduler:
           PrefixCache(self.block_allocator, block_size,
                       session_ttl_s=prefix_session_ttl_s,
                       max_cached_blocks=prefix_max_cached_blocks,
-                      clock=clock)
+                      clock=clock, version=self.checkpoint_version)
           if prefix_cache else None)
     else:
       if prefix_cache:
@@ -773,7 +799,21 @@ class FCFSScheduler:
     stream resume bit-exactly).  ``front=True`` preserves the migrated
     request's place in line (failover resubmits in REVERSE snapshot
     order so the head of the dead replica's line stays the head here).
-    Returns the restored uid."""
+    Returns the restored uid.
+
+    A snapshot pinned to a DIFFERENT checkpoint version is REFUSED
+    (docs/robustness.md "Blue/green rollout"): replaying its committed
+    prefix under other weights would silently fork the sample stream —
+    the router places it on a same-version survivor or parks it."""
+    pinned = snap["request"].get("checkpoint_version")
+    if pinned is not None and int(pinned) != self.checkpoint_version:
+      raise ValueError(
+          f"cross-version restore refused: request "
+          f"{snap['request'].get('uid')!r} is pinned to checkpoint "
+          f"version {int(pinned)} but this replica serves version "
+          f"{self.checkpoint_version} — prefix replay across versions "
+          f"is not bit-exact (migration policy is complete-in-place; "
+          f"docs/robustness.md)")
     req = Request.restore(snap["request"])
     req = dataclasses.replace(req, prompt=self.validate(req))
     restored_flow = req.flow_id is not None

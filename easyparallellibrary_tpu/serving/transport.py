@@ -375,6 +375,7 @@ class ProcessTransport(ReplicaTransport):
                rpc_retries: Optional[int] = None,
                rpc_backoff_s: Optional[float] = None,
                spawn_timeout_s: Optional[float] = None,
+               checkpoint: Optional[str] = None,
                start: bool = True):
     from easyparallellibrary_tpu.env import Env
     self.index = index
@@ -382,6 +383,11 @@ class ProcessTransport(ReplicaTransport):
     rconf = self._config.serving.router
     self._factory = factory_spec(factory)
     self._engine_kwargs = dict(engine_kwargs or {})
+    # Blue/green rollout (serving/rollout.py): when set, the child
+    # restores THIS checkpoint over the factory's params at init (the
+    # path rides the init frame; a validation failure fails the spawn,
+    # never a live request).
+    self._checkpoint = checkpoint
     self.rpc_timeout_s = (rpc_timeout_s if rpc_timeout_s is not None
                           else rconf.rpc_timeout_s)
     self.rpc_retries = (rpc_retries if rpc_retries is not None
@@ -487,6 +493,7 @@ class ProcessTransport(ReplicaTransport):
           "factory": self._factory,
           "engine_kwargs": self._engine_kwargs,
           "config": self._config.to_dict(),
+          "checkpoint": self._checkpoint,
       })
       reply = self._wait(init_id, timeout=self.spawn_timeout_s)
     except Exception:
@@ -828,6 +835,16 @@ class ProcessTransport(ReplicaTransport):
   def compile_count(self) -> int:
     return int(self._beat_get("compiles"))
 
+  @property
+  def checkpoint_version(self) -> int:
+    """This replica's checkpoint version, from the last wire beat
+    (falling back to the engine kwargs the child was spawned with —
+    correct before the first beat arrives, same pattern as
+    ``num_slots``)."""
+    return int(self._beat_get(
+        "checkpoint_version",
+        self._engine_kwargs.get("checkpoint_version", 0)))
+
   def rpc_counters(self) -> Dict[str, int]:
     return {"rpc_retries": int(self.rpc_retries_total),
             "rpc_timeouts": int(self.rpc_timeouts_total),
@@ -873,6 +890,18 @@ class ProcessTransport(ReplicaTransport):
   def restore_request(self, snap: Dict[str, Any],
                       front: bool = False) -> Any:
     uid = snap["request"]["uid"]
+    pinned = snap["request"].get("checkpoint_version")
+    if pinned is not None and int(pinned) != self.checkpoint_version:
+      # Refused BEFORE journaling: a cross-version snapshot must never
+      # enter this replica's recovery journal (the child would reject
+      # the replay anyway — the scheduler enforces the same policy —
+      # but the parent-side check keeps the refusal unambiguous and
+      # free of wire traffic).
+      raise ValueError(
+          f"cross-version restore refused: request {uid!r} is pinned to "
+          f"checkpoint version {int(pinned)} but replica {self.index} "
+          f"serves version {self.checkpoint_version} — prefix replay "
+          f"across versions is not bit-exact (docs/robustness.md)")
     self._journal[uid] = _JournalEntry(
         snap["request"], snap.get("submitted_at", time.monotonic()),
         generated=snap.get("generated"),

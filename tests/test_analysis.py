@@ -229,8 +229,10 @@ def test_host_sync_covers_transport_module(tmp_path):
 
 
 def test_host_sync_covers_actuator_modules(tmp_path):
-  """The self-healing actuators (ISSUE 13) are hot-path for epl-lint:
-  the SHIPPED serving/autotune.py and serving/autoscale.py scan as hot
+  """The self-healing actuators (ISSUE 13) and the rollout controller
+  (ISSUE 17) are hot-path for epl-lint: the SHIPPED
+  serving/autotune.py, serving/autoscale.py and serving/rollout.py
+  scan as hot
   (their breach handlers run inside the serving loop — an implicit
   device->host fetch a future edit introduces there is a finding, and
   the shipped baseline stays empty; the quick zero-findings acceptance
@@ -239,7 +241,8 @@ def test_host_sync_covers_actuator_modules(tmp_path):
   from easyparallellibrary_tpu.analysis.core import ModuleInfo
   from easyparallellibrary_tpu.analysis.rules import _is_hot
   pkg = package_root()
-  for rel in ("serving/autotune.py", "serving/autoscale.py"):
+  for rel in ("serving/autotune.py", "serving/autoscale.py",
+              "serving/rollout.py"):
     shipped = os.path.join(pkg, rel)
     assert os.path.exists(shipped)
     assert _is_hot(ModuleInfo(path=shipped, rel=rel, source="",

@@ -47,6 +47,8 @@ from easyparallellibrary_tpu.utils.retry import retry_call
 FACTORY = {"fn": "easyparallellibrary_tpu.testing.factories:tiny_gpt"}
 GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
                       "request_snapshot_v1.json")
+GOLDEN_V2 = os.path.join(os.path.dirname(__file__), "golden",
+                         "request_snapshot_v2.json")
 
 
 def _prompts(n, plen=6, vocab=64, seed=0):
@@ -91,18 +93,35 @@ def _assert_no_orphans(pids):
 
 
 def test_request_snapshot_matches_v1_golden():
-  """The v1 wire shape is PINNED: a future field change must bump
-  SNAPSHOT_VERSION and grow a new golden, not silently reshape what
-  crosses the failover wire."""
+  """The v1 wire shape stays READABLE forever: a v1 snapshot restores
+  with every v2 field at its compat default (``checkpoint_version``
+  None = unpinned), and re-snapshotting emits the pinned v2 shape —
+  a future field change must bump SNAPSHOT_VERSION and grow a new
+  golden, not silently reshape what crosses the failover wire."""
   with open(GOLDEN) as f:
     golden = json.load(f)
   restored = Request.restore(golden)
   assert restored.uid == "golden-1"
   assert restored.priority == "latency"
+  assert restored.checkpoint_version is None
   assert np.array_equal(restored.prompt, np.asarray([5, 6, 7, 8]))
+  with open(GOLDEN_V2) as f:
+    golden_v2 = json.load(f)
+  resnap = json.loads(json.dumps(restored.snapshot()))
+  assert resnap == golden_v2
+  assert golden["v"] == 1
+
+
+def test_request_snapshot_matches_v2_golden():
+  """The v2 wire shape is PINNED (v2 added ``checkpoint_version``, the
+  blue/green rollout's cross-version replay fence)."""
+  with open(GOLDEN_V2) as f:
+    golden = json.load(f)
+  restored = Request.restore(golden)
+  assert restored.uid == "golden-1"
   resnap = json.loads(json.dumps(restored.snapshot()))
   assert resnap == golden
-  assert golden["v"] == SNAPSHOT_VERSION == 1
+  assert golden["v"] == SNAPSHOT_VERSION == 2
 
 
 def test_request_snapshot_carries_version_and_rejects_unknown():
