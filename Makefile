@@ -148,6 +148,23 @@ router-bench:
 	python benchmarks/router_failover.py
 	python benchmarks/router_failover.py --transport process
 
+# Cost-card fleet simulator: golden replay-fidelity check (the sim
+# must reproduce the recorded real-fleet chaos-heal actuation sequence
+# exactly), then 100-replica diurnal + overload sweeps and a
+# 1000-replica diurnal sweep with the full policy stack live —
+# wall-seconds-per-simulated-hour recorded, speedup_x >= 100x at 100
+# replicas pinned by make perf-gate (benchmarks/sim_fleet.py ->
+# BENCH_EVIDENCE.json with provenance=sim; docs/simulator.md).
+sim-bench:
+	python benchmarks/sim_fleet.py
+
+# Re-record the golden chaos-heal episode from a REAL 2-replica fleet
+# (only when a policy change legitimately changes the actuation story;
+# the golden-file diff then documents it — benchmarks/sim_golden.py ->
+# tests/golden/sim_chaos_heal.json).
+sim-golden:
+	python benchmarks/sim_golden.py
+
 # Tiny traced fit() + serving + router-failover episode on the CPU mesh
 # -> trace_demo.json (schema-validated incl. request-flow events; load
 # at ui.perfetto.dev; docs/observability.md).
@@ -183,6 +200,8 @@ help:
 	@echo "  spec-bench     - speculative vs plain decode"
 	@echo "  overload-bench - admission control under Poisson overload"
 	@echo "  router-bench   - replica-kill failover episode (0 lost requests)"
+	@echo "  sim-bench      - fleet simulator: replay fidelity + 100/1000-replica sweeps"
+	@echo "  sim-golden     - re-record the golden chaos-heal episode (real fleet)"
 	@echo "  trace-demo     - emit + validate a demo trace (fit/serving/failover)"
 	@echo "  obs-bench      - tracer+SLO overhead evidence (<=5% budget)"
 	@echo "  clean          - clean native build artifacts"
@@ -191,4 +210,4 @@ help:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test lint perf-gate gate bench chaos chaos-serve chaos-router chaos-proc chaos-heal chaos-rollout serve-bench paged-bench prefix-bench spec-bench overload-bench router-bench heal-bench rollout-bench trace-demo obs-bench help clean
+.PHONY: all build test lint perf-gate gate bench chaos chaos-serve chaos-router chaos-proc chaos-heal chaos-rollout serve-bench paged-bench prefix-bench spec-bench overload-bench router-bench heal-bench rollout-bench sim-bench sim-golden trace-demo obs-bench help clean

@@ -36,3 +36,4 @@ evidence_path = bench_evidence.evidence_path
 load_records = bench_evidence.load_records
 latest_record = bench_evidence.latest_record
 validate_record = bench_evidence.validate_record
+run_context = bench_evidence.run_context

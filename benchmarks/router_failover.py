@@ -170,10 +170,14 @@ def run(num_requests: int = 32, num_slots: int = 4, chunk: int = 4,
   assert set(kill_out) == set(base_out)
   exact = all(np.array_equal(kill_out[i], base_out[i])
               for i in kill_out)
+  import _evidence  # the validated shared writer
   record = {
       "metric": METRIC,
       "backend": jax.devices()[0].platform,
       "device_kind": jax.devices()[0].device_kind,
+      # Honesty tags: measured episode (provenance=hardware) + the
+      # host-core count behind any scaling claim.
+      **_evidence.run_context(),
       "config": {
           "model": {"d_model": cfg.d_model, "num_layers": cfg.num_layers,
                     "vocab": cfg.vocab_size},
@@ -189,7 +193,6 @@ def run(num_requests: int = 32, num_slots: int = 4, chunk: int = 4,
       "tokens_per_s_scaling": fleet["tokens_per_s"]
           / max(single["tokens_per_s"], 1e-9),
   }
-  import _evidence  # the validated shared writer
   _evidence.append_record(record)
   print(json.dumps(record))
   assert lost == 0, f"{lost} request(s) lost in the kill episode"
@@ -328,10 +331,12 @@ def run_process(num_requests: int = 32, num_slots: int = 4,
               for i in kill_out)
   scaling = fleet["tokens_per_s"] / max(single["tokens_per_s"], 1e-9)
   host_cores = os.cpu_count() or 1
+  import _evidence  # the validated shared writer
   record = {
       "metric": PROCESS_METRIC,
       "backend": jax.devices()[0].platform,
       "device_kind": jax.devices()[0].device_kind,
+      **_evidence.run_context(),
       "config": {
           "transport": "process",
           "factory": PROCESS_FACTORY["kwargs"],
@@ -360,7 +365,6 @@ def run_process(num_requests: int = 32, num_slots: int = 4,
       "orphans_after": (single["orphans_after"] + fleet["orphans_after"]
                         + kill["orphans_after"]),
   }
-  import _evidence  # the validated shared writer
   _evidence.append_record(record)
   print(json.dumps(record))
   assert lost == 0, f"{lost} request(s) lost in the SIGKILL episode"

@@ -65,8 +65,10 @@ _DEFAULT_NAMESPACES = ("train", "serving", "comm", "resilience")
 
 # Modules whose function bodies are hot paths for the host-sync rule
 # (ISSUE 10: the serving loop, the training loop, and the observability
-# layer, which promises zero added syncs).
-_HOT_MARKERS = ("serving/", "observability/")
+# layer, which promises zero added syncs; ISSUE 18: the fleet
+# simulator's sweep loop — a host sync there multiplies by 100-1000
+# replicas per sweep and silently eats the >=100x speedup pin).
+_HOT_MARKERS = ("serving/", "observability/", "sim/")
 _HOT_SUFFIXES = ("runtime/loop.py",)
 
 # Callable parameter names treated as jitted-step entries even though

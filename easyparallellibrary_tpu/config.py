@@ -596,6 +596,22 @@ class ServingConfig(_Category):
       # Extra SLO rule names (beyond every burn-rate rule, which always
       # actuates) whose breaches trigger scale-up, e.g. "ttft_p99".
       "autoscale.rules": (),
+      # Spawn replicas synchronously inside on_step() instead of on the
+      # router's spawn thread.  Deterministic (replay/simulation) at the
+      # cost of blocking the sweep for the spawn's duration; the async
+      # path stays the production default.
+      "autoscale.sync_spawn": False,
+      # Predictive scale-up (promoted from fleet simulation, see
+      # docs/simulator.md): sample the router's cumulative submitted
+      # count, estimate the arrival-rate slope over this window as
+      # (late-half rate - early-half rate) / (window/2), and scale up
+      # BEFORE the burn-rate breach when the slope exceeds the
+      # threshold below.  0 slope = rule off (the repo-wide idiom).
+      "autoscale.predictive_window_s": 1.0,
+      # Arrival-rate slope threshold in requests/s per second.  Tune
+      # via `make sim-bench`; must stay high enough that steady
+      # fault-free traffic (slope ~ 0) never fires it.
+      "autoscale.predictive_slope": 0.0,
       # --- blue/green checkpoint rollout (serving/rollout.py,
       # docs/robustness.md "Blue/green rollout").  A RolloutController
       # on the router ships checkpoint N+1 under live traffic: validate
@@ -773,6 +789,44 @@ class ObservabilityConfig(_Category):
     return _SubGroup(self, "device")
 
 
+class SimConfig(_Category):
+  """Cost-card fleet simulator (easyparallellibrary_tpu/sim/,
+  docs/simulator.md).  Every knob feeds the discrete-event episode
+  builder only — nothing here is read by live serving."""
+  _name = "sim"
+  _fields = {
+      # Seed for the simulator's xorshift RNG (arrivals, prompt shapes,
+      # fault draws).  Same seed + same config = bit-identical episode.
+      "seed": 0,
+      # Fleet size for a sweep episode (the replay harness takes its
+      # size from the recorded episode instead).
+      "replicas": 100,
+      # Simulated episode length in virtual seconds.
+      "duration_s": 60.0,
+      # Arrival trace shape: poisson | zipf | diurnal | overload
+      # (sim/arrivals.py; diurnal modulates a Poisson base rate by a
+      # day-curve, overload reuses testing/chaos.py's burst shape).
+      "trace": "diurnal",
+      # Mean arrival rate in requests/s across the whole fleet
+      # (0 = derive from the fleet's modeled capacity: ~70% of
+      # aggregate decode throughput, so default sweeps run loaded but
+      # not saturated).
+      "arrival_rate_rps": 0.0,
+      # SimReplica step-cost physics, seconds per token.  0 = calibrate
+      # from the newest hardware-provenance serving record in
+      # BENCH_EVIDENCE.json (sim/replica.py::calibrate); set explicitly
+      # to model other hardware from its cost card.
+      "prefill_token_cost_s": 0.0,
+      "decode_token_cost_s": 0.0,
+      # Fixed per-step host overhead (dispatch, bookkeeping) added to
+      # every modeled step.
+      "step_overhead_s": 5e-5,
+      # Fault injector: virtual seconds a simulated spawn takes before
+      # the new replica lands (0 = spawns land on the next sweep).
+      "spawn_delay_s": 0.0,
+  }
+
+
 class Config:
   """Root configuration (reference: epl/config.py:181).
 
@@ -787,7 +841,7 @@ class Config:
       AutoParallelConfig, IOConfig, CommunicationConfig, PipelineConfig,
       GradientCheckpointConfig, ZeroConfig, OffloadConfig, AMPConfig,
       ClusterConfig, OptimizerConfig, SequenceConfig, ResilienceConfig,
-      ServingConfig, ObservabilityConfig,
+      ServingConfig, ObservabilityConfig, SimConfig,
   )
 
   def __init__(self, param_dict: Dict[str, Any] | None = None):
@@ -1067,10 +1121,30 @@ class Config:
           f"got min_replicas={scale.min_replicas}, "
           f"max_replicas={scale.max_replicas}")
     for field in ("scale_up_cooldown_s", "scale_down_cooldown_s",
-                  "flap_window_s"):
+                  "flap_window_s", "predictive_slope"):
       if getattr(scale, field) < 0:
         raise ValueError(f"serving.autoscale.{field} must be >= 0; "
                          f"got {getattr(scale, field)}")
+    if scale.predictive_window_s <= 0:
+      raise ValueError(
+          f"serving.autoscale.predictive_window_s must be > 0 (the "
+          f"slope estimate divides by it); got "
+          f"{scale.predictive_window_s}")
+    sim = self.sim
+    if sim.replicas < 1:
+      raise ValueError(f"sim.replicas must be >= 1; got {sim.replicas}")
+    if sim.duration_s <= 0:
+      raise ValueError(f"sim.duration_s must be > 0; got {sim.duration_s}")
+    if sim.trace not in ("poisson", "zipf", "diurnal", "overload"):
+      raise ValueError(
+          f"sim.trace must be one of poisson/zipf/diurnal/overload; "
+          f"got {sim.trace!r}")
+    for field in ("arrival_rate_rps", "prefill_token_cost_s",
+                  "decode_token_cost_s", "step_overhead_s",
+                  "spawn_delay_s"):
+      if getattr(sim, field) < 0:
+        raise ValueError(f"sim.{field} must be >= 0; "
+                         f"got {getattr(sim, field)}")
     roll = self.serving.rollout
     if not 0.0 < roll.canary_frac <= 1.0:
       raise ValueError(

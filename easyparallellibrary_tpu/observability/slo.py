@@ -65,6 +65,7 @@ import weakref
 from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Mapping, Optional, Tuple
 
+from easyparallellibrary_tpu.utils import vclock
 from easyparallellibrary_tpu.utils.logging import get_logger
 
 _OPS = {
@@ -209,7 +210,7 @@ class DiagnosticCapture:
 
   def __init__(self, out_dir: str, limit: int = 8,
                min_interval_s: float = 30.0, ring_tail: int = 2048,
-               clock: Callable[[], float] = time.monotonic):
+               clock: Callable[[], float] = vclock.monotonic):
     if limit < 1:
       raise ValueError(f"limit must be >= 1: {limit}")
     if min_interval_s < 0 or ring_tail < 1:
@@ -271,7 +272,7 @@ class DiagnosticCapture:
   def _write(self, reason, seq, step, payload, context, tracer,
              registry) -> str:
     slug = re.sub(r"[^A-Za-z0-9_-]+", "_", reason)[:48] or "anomaly"
-    name = f"bundle_{int(time.time())}_{seq:04d}_{slug}"
+    name = f"bundle_{int(vclock.wall())}_{seq:04d}_{slug}"
     final = os.path.join(self.out_dir, name)
     tmp = final + ".tmp"
     os.makedirs(tmp, exist_ok=True)
@@ -281,7 +282,7 @@ class DiagnosticCapture:
         json.dump(self._json_safe(obj), f, indent=1)
 
     dump("meta.json", {
-        "reason": reason, "step": step, "time": time.time(),
+        "reason": reason, "step": step, "time": vclock.wall(),
         "payload": payload or {}})
     if tracer is not None and getattr(tracer, "enabled", False):
       events = tracer.events()
@@ -336,7 +337,7 @@ class SLOMonitor:
   def __init__(self, rules: Optional[List[Any]] = None,
                events_path: str = "",
                capture: Optional[DiagnosticCapture] = None,
-               wall_clock: Callable[[], float] = time.time,
+               wall_clock: Callable[[], float] = vclock.wall,
                history_limit: int = 1024):
     self.rules = list(rules or ())
     names = [r.name for r in self.rules]

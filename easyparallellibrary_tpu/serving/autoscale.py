@@ -55,7 +55,8 @@ drive it with fake replicas and a fake clock
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from easyparallellibrary_tpu.env import Env
 from easyparallellibrary_tpu.observability import trace as trace_lib
@@ -87,6 +88,20 @@ class FleetAutoscaler:
     self.scale_down_cooldown_s = conf.scale_down_cooldown_s
     self.flap_window_s = conf.flap_window_s
     self._rules = set(conf.rules)
+    # Deterministic spawn lever (replay/simulation): grow replicas
+    # synchronously inside on_step instead of on the spawner thread.
+    self.sync_spawn = conf.sync_spawn
+    # Predictive scale-up (config comment): differentiate the router's
+    # cumulative submit counter over a sliding window and grow when the
+    # arrival-rate SLOPE says the burn is coming — before the burn-rate
+    # rule can have breached.  slope <= 0 disables the rule.
+    self.predictive_window_s = conf.predictive_window_s
+    self.predictive_slope = conf.predictive_slope
+    self._demand_samples: Deque[Tuple[float, int]] = deque()
+    self.predictive_fires = 0
+    # First landed grow of this policy's lifetime — the time-to-react
+    # evidence `make heal-bench` compares predictive vs reactive on.
+    self.first_scale_up_t: Optional[float] = None
     self.scale_ups = 0
     self.scale_downs = 0
     self.holds = 0              # actions suppressed by cooldown/hold-out
@@ -193,6 +208,40 @@ class FleetAutoscaler:
         self._last_breach_t = self.clock()
     return pressured
 
+  def _demand_slope(self, now: float) -> Optional[float]:
+    """Sample the router's cumulative demand counter and estimate the
+    arrival-rate slope (requests/s per second) over the sliding window:
+    the late-half rate minus the early-half rate, over half the span.
+    Returns None while the rule is off, the window has not filled yet
+    (startup must never read as a ramp), or the halves are degenerate.
+    Two-half differencing instead of least squares on purpose: it is
+    O(1) per sweep, exactly reproducible, and a steady Poisson stream's
+    halves agree in expectation — slope ~ 0, so fault-free traffic
+    cannot fire the rule."""
+    if self.predictive_slope <= 0:
+      return None
+    count = getattr(self.router, "submitted_total", None)
+    if count is None:
+      return None
+    samples = self._demand_samples
+    samples.append((now, int(count)))
+    cutoff = now - self.predictive_window_s
+    # Keep s[0] as the newest sample at-or-before the cutoff so the
+    # retained span always covers the full window.
+    while len(samples) >= 2 and samples[1][0] <= cutoff:
+      samples.popleft()
+    t0, c0 = samples[0]
+    span = now - t0
+    if span < self.predictive_window_s * 0.95:
+      return None
+    mid = now - span / 2.0
+    tp, cp = min(samples, key=lambda tc: abs(tc[0] - mid))
+    if not t0 < tp < now:
+      return None
+    early = (cp - c0) / (tp - t0)
+    late = (count - cp) / (now - tp)
+    return (late - early) / (span / 2.0)
+
   @property
   def spawn_in_flight(self) -> bool:
     """True while an off-thread cold spawn is running or its outcome
@@ -232,6 +281,9 @@ class FleetAutoscaler:
     then act on a recorded breach (grow) or on a recovered budget
     (shrink), honoring bounds/cooldowns/hold-outs."""
     now = self.clock() if now is None else now
+    # Demand sampling runs every sweep — held or not — so the slope
+    # estimate never has a hole exactly where the interesting window is.
+    slope = self._demand_slope(now)
     with self._lock:
       outcome, self._spawn_outcome = self._spawn_outcome, None
     if outcome is not None:
@@ -259,6 +311,17 @@ class FleetAutoscaler:
       rule, self._pending_rule = self._pending_rule, None
     if rule is not None:
       self._maybe_scale_up(rule, now)
+      return
+    if (slope is not None and slope >= self.predictive_slope
+        and len(self._live()) < self.max_replicas
+        and (self._last_up_t is None
+             or now - self._last_up_t >= self.scale_up_holdout_s())):
+      # Arrival-rate slope says the burn is COMING: grow now, while the
+      # spawn still lands before the queue does.  Pre-gated (like the
+      # sustained path) so a high slope inside the hold-out window does
+      # not spin the holds counter every sweep.
+      self.predictive_fires += 1
+      self._maybe_scale_up("predictive", now)
       return
     # _pressure() refreshes _last_breach_t while the breached streams'
     # records keep flowing — a live sustained burn keeps the quiet
@@ -323,7 +386,8 @@ class FleetAutoscaler:
       self._parked.remove(index)
       self._land_grow(index, "rejoin", rule, now)
       return
-    if getattr(router, "spawn_recipe_available", False):
+    if (not self.sync_spawn
+        and getattr(router, "spawn_recipe_available", False)):
       # Cold spawn OFF the sweep thread (ROADMAP item 5 leftover
       # closed): the subprocess spawn + in-child compile can take
       # seconds, and a synchronous add would stall every live replica
@@ -332,8 +396,9 @@ class FleetAutoscaler:
       # sweep.
       self._start_spawn(rule)
       return
-    # No build recipe (injected test fleets): the synchronous operator
-    # lever is the only grow path.
+    # No build recipe (injected test fleets), or sync_spawn pinned for
+    # replay determinism: the synchronous operator lever is the grow
+    # path.
     try:
       index = router.add_replica()
     except Exception as e:  # noqa: BLE001 — a failed spawn must not
@@ -411,6 +476,8 @@ class FleetAutoscaler:
       # LANDED: the load is oscillating around the capacity step, so
       # the next hold-out doubles (a failed spawn is not a flap).
       self.flap_trips = min(self.flap_trips + 1, _MAX_FLAP_DOUBLINGS)
+    if self.first_scale_up_t is None:
+      self.first_scale_up_t = now
     self.scale_ups += 1
     # Stamp AFTER the action: a cold spawn takes seconds, and a
     # cooldown counted from before it would let the very next sweep
@@ -444,7 +511,8 @@ class FleetAutoscaler:
     return {"scale_ups": float(self.scale_ups),
             "scale_downs": float(self.scale_downs),
             "autoscale_holds": float(self.holds),
-            "flap_trips": float(self.flap_trips)}
+            "flap_trips": float(self.flap_trips),
+            "predictive_fires": float(self.predictive_fires)}
 
   def _emit(self, action: str, mechanism: str, index: int,
             rule: str) -> None:

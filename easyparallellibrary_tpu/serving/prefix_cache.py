@@ -130,27 +130,39 @@ def block_prefix_keys(prompt, block_size: int,
 
 
 class _Node:
-  """One cached block: the exact token bytes that filled it, the pool
-  block carrying their K/V, and its place in the tree."""
+  """One cached block: its chained content digest (the child key in its
+  parent), the exact tokens that filled it (collision verification),
+  the pool block carrying their K/V, and its place in the tree."""
 
-  __slots__ = ("key", "block", "parent", "children", "last_touch")
+  __slots__ = ("key", "tokens", "block", "parent", "children",
+               "last_touch")
 
-  def __init__(self, key: bytes, block: int, parent: "_Node",
-               now: float):
+  def __init__(self, key: int, tokens: Optional[np.ndarray], block: int,
+               parent: "_Node", now: float):
     self.key = key
+    self.tokens = tokens
     self.block = block
     self.parent = parent
-    self.children: Dict[bytes, "_Node"] = {}
+    self.children: Dict[int, "_Node"] = {}
     self.last_touch = now
 
 
 class PrefixCache:
   """Content-addressed radix tree over prompt blocks (module docstring).
 
-  Children are keyed by the block's EXACT token bytes (no hash, no
-  collisions): a match is a byte-equality walk, so a mapped block is
-  guaranteed to carry the K/V of precisely the tokens being admitted —
-  the bit-exactness contract needs nothing weaker.  The tree owns one
+  Children are keyed by a CHAINED per-block content digest, cached on
+  the node at registration time — the same crc32 chain as
+  :func:`block_prefix_keys`, so the tree's child key at depth ``d`` IS
+  the router's affinity key for that prefix depth.  An admission walk
+  therefore hashes each block's tokens once (crc32 straight over the
+  int32 buffer — no byte-string key construction, no long-key dict
+  hashing) and looks children up by int.  crc32 is not
+  collision-free and a collision serving wrong K/V would break the
+  bit-exactness contract, so a digest hit is verified against the
+  node's stored tokens (one flat ``np.array_equal`` — a memcmp, still
+  cheaper than keying the dict by the bytes themselves); a mismatch
+  reads as a miss at that depth (match) or stops descent (register —
+  the first writer keeps the canonical digest).  The tree owns one
   allocator reference per registered block (dropped on eviction /
   expiry / invalidation); mapping a match into a slot adds the slot's
   own reference on top, so a block is never freed while any table still
@@ -177,16 +189,17 @@ class PrefixCache:
     self.session_ttl_s = session_ttl_s
     self.max_cached_blocks = max_cached_blocks
     self.clock = clock
-    # Checkpoint-version isolation (blue/green rollout): depth-0 keys
-    # carry a version tag, so K/V cached under checkpoint N can NEVER
-    # satisfy a match under N+1 — identical tokens under different
-    # weights are different content (silent wrong-weights reuse would
-    # be a correctness bug the moment two versions coexist).  Version 0
-    # keeps empty-tag keys, byte-identical to the unversioned past.
+    # Checkpoint-version isolation (blue/green rollout): the digest
+    # chain is SEEDED with the version-folded salt (exactly
+    # block_prefix_keys' seed), so K/V cached under checkpoint N can
+    # NEVER satisfy a match under N+1 — identical tokens under
+    # different weights are different content (silent wrong-weights
+    # reuse would be a correctness bug the moment two versions
+    # coexist).  Version 0 keeps the bare salt, digest-identical to
+    # the unversioned past.
     self.version = int(version)
-    self._vtag = (b"" if self.version == 0
-                  else b"v%d:" % self.version)
-    self._root = _Node(b"", NULL_BLOCK, None, 0.0)  # sentinel, no block
+    self._chain_seed = _version_salt(_BLOCK_SALT, self.version)
+    self._root = _Node(0, None, NULL_BLOCK, None, 0.0)  # sentinel
     # Insertion/touch-ordered node registry: front = least recent.  The
     # deepest-first path-touch discipline (module docstring) keeps the
     # front a leaf, so LRU eviction never needs tree surgery.
@@ -232,17 +245,17 @@ class PrefixCache:
     divergent/partial block is always rebuilt by prefill, never shared
     (COW rule, module docstring).  Counts one hit (any block matched)
     or one miss per call."""
-    prefix = np.asarray(prefix, np.int32).reshape(-1)
+    prefix = np.ascontiguousarray(np.asarray(prefix, np.int32)
+                                  .reshape(-1))
     bs = self.block_size
     limit = max(0, int(prefix.size) - 1) // bs
-    node, path = self._root, []
+    node, path, crc = self._root, [], self._chain_seed
     for d in range(limit):
-      key = prefix[d * bs:(d + 1) * bs].tobytes()
-      if d == 0:
-        key = self._vtag + key  # version-scoped root fan-out
-      child = node.children.get(key)
-      if child is None:
-        break
+      chunk = prefix[d * bs:(d + 1) * bs]
+      crc = zlib.crc32(chunk, crc)   # chained digest, no bytes copy
+      child = node.children.get(crc)
+      if child is None or not np.array_equal(child.tokens, chunk):
+        break  # unknown depth, or a crc collision: never serve it
       path.append(child)
       node = child
     if not path:
@@ -268,23 +281,30 @@ class PrefixCache:
     wins — first writer keeps the canonical block; the duplicate stays
     privately owned by its slot and frees on retirement.  Returns the
     number of new insertions."""
-    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    tokens = np.ascontiguousarray(np.asarray(tokens, np.int32)
+                                  .reshape(-1))
     bs = self.block_size
     num_blocks = min(num_blocks, int(tokens.size) // bs, len(blocks))
     node, path, added = self._root, [], 0
     now = self.clock()
+    crc = self._chain_seed
     for d in range(num_blocks):
-      key = tokens[d * bs:(d + 1) * bs].tobytes()
-      if d == 0:
-        key = self._vtag + key  # version-scoped root fan-out
-      child = node.children.get(key)
+      chunk = tokens[d * bs:(d + 1) * bs]
+      crc = zlib.crc32(chunk, crc)   # digest cached on the node below
+      child = node.children.get(crc)
+      if child is not None and not np.array_equal(child.tokens, chunk):
+        # crc collision under this parent: the existing node keeps the
+        # canonical digest; the newcomer's blocks stay privately owned
+        # by their slot (same first-writer-wins rule as content
+        # collisions), and nothing below this depth is addressable.
+        break
       if child is None:
         blk = blocks[d]
         if blk == NULL_BLOCK:
           break  # trash row: garbage content, never shareable
         self.allocator.incref(blk)
-        child = _Node(key, blk, node, now)
-        node.children[key] = child
+        child = _Node(crc, chunk.copy(), blk, node, now)
+        node.children[crc] = child
         self._lru[child] = None
         added += 1
       path.append(child)

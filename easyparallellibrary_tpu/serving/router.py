@@ -123,7 +123,7 @@ class Router:
   def __init__(self, model=None, params=None, *, num_replicas=None,
                mesh=None, registry=None, config=None,
                clock=time.monotonic, replicas=None, factory=None,
-               transport=None, **engine_kwargs):
+               replica_factory=None, transport=None, **engine_kwargs):
     root_config = config if config is not None else Env.get().config
     rconf = root_config.serving.router
     self._root_config = root_config
@@ -148,8 +148,13 @@ class Router:
                       else rconf.transport)
     # Everything add_replica() needs to build one more fleet member —
     # the autoscaler's cold scale-up path.  Injected (test) replica
-    # lists carry no recipe, so the fleet cannot grow there.
+    # lists carry no recipe, so the fleet cannot grow there — unless
+    # the caller supplies `replica_factory` (an ``index -> replica``
+    # callable), the seam that lets an injected fleet (the cost-card
+    # simulator, scaling tests) grow through the SAME autoscaler code
+    # path as a recipe-built one.
     self._replica_spec: Optional[Dict[str, Any]] = None
+    self._replica_factory = replica_factory
     if replicas is not None:
       self.replicas: List[EngineReplica] = list(replicas)
       self.transport = "injected"
@@ -215,6 +220,7 @@ class Router:
     self._drain_deadline: Dict[int, float] = {}
     self._rejoined_at: Dict[int, float] = {}
     self.steps = 0
+    self.submitted_total = 0         # submit() calls (demand signal)
     self.failovers = 0               # replica-down events that migrated
     self.migrated_requests = 0       # snapshots moved (failover + drain)
     self.router_shed = 0             # shed here: no routable replica
@@ -253,11 +259,13 @@ class Router:
 
   @property
   def spawn_recipe_available(self) -> bool:
-    """True when this router can BUILD new replicas (it constructed its
-    own fleet, so the recipe is on hand).  Injected-replica fleets
-    (tests) cannot grow — and the autoscaler's off-thread spawn path
-    keys off this to fall back to the synchronous lever."""
-    return self._replica_spec is not None
+    """True when this router can BUILD new replicas — it constructed
+    its own fleet (recipe on hand) or was handed a ``replica_factory``.
+    Injected-replica fleets without a factory (tests) cannot grow — and
+    the autoscaler's off-thread spawn path keys off this to fall back
+    to the synchronous lever."""
+    return (self._replica_spec is not None
+            or self._replica_factory is not None)
 
   def build_replica(self, index: Optional[int] = None, *,
                     checkpoint: Optional[str] = None,
@@ -279,6 +287,15 @@ class Router:
     recipe itself, so later autoscale spawns and breaker respawns serve
     the new version with no override."""
     if self._replica_spec is None:
+      if self._replica_factory is not None:
+        if (checkpoint is not None or checkpoint_version is not None
+            or params is not None):
+          raise RuntimeError(
+              "build_replica() overrides (checkpoint/version/params) "
+              "are recipe levers; a replica_factory fleet builds "
+              "replicas from the factory alone")
+        return self._replica_factory(
+            len(self.replicas) if index is None else index)
       raise RuntimeError(
           "build_replica() needs a router that built its own replicas; "
           "a fleet constructed from injected replicas carries no "
@@ -492,6 +509,11 @@ class Router:
     child-side uid dedup stops a retried wire call from double
     admitting."""
     prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+    # Cumulative demand counter — counts every arrival regardless of
+    # outcome (admitted, replica-shed, router-shed), so rate samples
+    # over it measure offered load, not accepted load.  The predictive
+    # autoscale rule differentiates it (serving/autoscale.py).
+    self.submitted_total += 1
     # The trace-context id is minted HERE — the earliest point the
     # request touches the fleet — so its flow arc starts at routing and
     # stays one connected thread through dispatch, admission, any

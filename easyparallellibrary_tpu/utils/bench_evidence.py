@@ -31,6 +31,24 @@ def evidence_path() -> str:
   return os.environ.get("EPL_BENCH_EVIDENCE", _DEFAULT_PATH)
 
 
+def run_context(sim: bool = False, **extra: Any) -> Dict[str, Any]:
+  """The uniform context block every evidence writer stamps:
+  ``host_cores`` (the honesty tag behind every "scaling" claim on a
+  shared box) and ``provenance`` — ``"sim"`` for numbers produced by
+  the cost-card simulator, ``"hardware"`` for measured ones.  A
+  sim-derived record can then never be mistaken for a measurement:
+  consumers (bench.py fallback, sim/replica.py calibration) filter on
+  the tag, and :func:`append_record` back-fills it for writers that
+  predate the tag — which also means an OLD record without the key is
+  exactly as trustworthy as one stamped "hardware", because that is
+  what it would have been stamped.  ``extra`` keys ride along
+  (e.g. ``backend=...``)."""
+  ctx: Dict[str, Any] = {"host_cores": os.cpu_count() or 1,
+                         "provenance": "sim" if sim else "hardware"}
+  ctx.update(extra)
+  return ctx
+
+
 def load_records(path: Optional[str] = None) -> List[Dict[str, Any]]:
   path = path or evidence_path()
   try:
@@ -71,6 +89,12 @@ def append_record(record: Dict[str, Any],
   record.setdefault("unix_time", time.time())
   record.setdefault("utc", time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                          time.gmtime()))
+  # Uniform honesty tags (run_context): a writer that did not stamp
+  # them gets the truthful defaults — this process's core count, and
+  # "hardware" (a sim writer MUST tag itself via run_context(sim=True);
+  # the simulator's own writers all do).
+  for key, val in run_context().items():
+    record.setdefault(key, val)
   errors = validate_record(record)
   if errors:
     raise ValueError(
@@ -105,7 +129,7 @@ def latest_record(metric: str,
 _NAME_KEY = "metric"
 _TS_KEYS = ("unix_time", "utc")
 _CONTEXT_KEYS = ("config", "backend", "device", "device_kind",
-                 "host_cores")
+                 "host_cores", "provenance")
 _HEADLINE_KEYS = ("value", "unit")
 
 

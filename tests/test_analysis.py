@@ -261,6 +261,36 @@ def test_host_sync_covers_actuator_modules(tmp_path):
   assert [f.line for f in findings] == [8]
 
 
+def test_host_sync_covers_sim_modules(tmp_path):
+  """The fleet simulator (ISSUE 18) is hot-path for epl-lint: the
+  SHIPPED sim/replica.py and sim/fleet.py scan as hot (the sweep loop
+  runs per-replica-per-sweep at 100-1000-replica scale, so an implicit
+  device->host fetch a future edit introduces there is a finding, and
+  the shipped baseline stays empty; the quick zero-findings acceptance
+  below enforces that), pinned against a fixture twin so a marker
+  refactor cannot silently drop the package."""
+  from easyparallellibrary_tpu.analysis.core import ModuleInfo
+  from easyparallellibrary_tpu.analysis.rules import _is_hot
+  pkg = package_root()
+  for rel in ("sim/replica.py", "sim/fleet.py"):
+    shipped = os.path.join(pkg, rel)
+    assert os.path.exists(shipped)
+    assert _is_hot(ModuleInfo(path=shipped, rel=rel, source="",
+                              tree=None, parse_error=None)), rel
+  path = _write(tmp_path, "sim/replica.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def step_cost(x):
+        return float(np.asarray(_fn(x)).sum())
+      """)
+  findings = _by_rule(_run(path), "host-sync")
+  assert [f.line for f in findings] == [8]
+
+
 def test_host_sync_flags_implicit_bool_and_float(tmp_path):
   _write(tmp_path, "runtime/loop.py", """\
       def fit(step_fn, state, batch):

@@ -15,6 +15,7 @@ from easyparallellibrary_tpu.parallel import (
     TrainState, create_sharded_train_state, make_train_step, parallelize)
 
 
+@pytest.mark.slow
 def test_dp_tp_pp_zero_training():
   env = epl.init(epl.Config({"pipeline.num_micro_batch": 2,
                              "zero.level": "v1"}))

@@ -27,6 +27,7 @@ def test_bert_forward_shape():
   assert logits.shape == (2, 8, 128)
 
 
+@pytest.mark.slow
 def test_bert_pipeline_matches_sequential():
   import dataclasses
   env = epl.init()
@@ -42,6 +43,7 @@ def test_bert_pipeline_matches_sequential():
   np.testing.assert_allclose(out_pp, out_seq, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bert_mlm_training():
   env = epl.init()
   mesh = epl.current_plan().build_mesh()
@@ -116,6 +118,7 @@ def test_resnet_dp_training_with_split_head():
   assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_bert_qa_head_trains():
   from easyparallellibrary_tpu.models.bert import (
       BertForQuestionAnswering, bert_qa_loss)
@@ -145,6 +148,7 @@ def test_bert_qa_head_trains():
   assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_resnet_batchnorm_variant_trains():
   """norm="batch" ResNet: BatchNorm stats live in a mutable collection
   carried by MutableTrainState; under GSPMD the (data-sharded) batch
@@ -247,6 +251,7 @@ def _bert_mlm_batch(B, S, V, masked_per_sample=2):
 
 
 @pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+@pytest.mark.slow
 def test_bert_smap_matches_sequential(schedule):
   """The shard_map pipeline engines drive BERT too (round 4: the engine
   is framework infrastructure, not a GPT special case) — loss and grads
@@ -276,6 +281,7 @@ def test_bert_smap_matches_sequential(schedule):
       g1, g2)
 
 
+@pytest.mark.slow
 def test_bert_smap_interleaved_matches_sequential():
   """Megatron-interleaved 1F1B for BERT (VERDICT r4 item 6): K=2 virtual
   chunks via the SHARED K-pass stacking helpers — loss and grads match
@@ -306,6 +312,7 @@ def test_bert_smap_interleaved_matches_sequential():
       g1, g2)
 
 
+@pytest.mark.slow
 def test_bert_smap_config_dispatch_trains():
   """pipeline.engine="smap" dispatches BERT through
   make_bert_train_step; loss decreases."""
@@ -338,6 +345,7 @@ def test_bert_smap_config_dispatch_trains():
   assert all(np.isfinite(l) for l in losses) and losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_bert_smap_zero_v1_matches_baseline():
   """ZeRO-1 rides the BERT smap wiring too (shared zero1_grad_layout):
   same trajectory as the plain engine, reduce-scatter in the program."""
@@ -392,6 +400,7 @@ def _ragged_mlm_batch(B, S, V, masked_per_sample=3):
   return {"ids": ids, "labels": labels, "mask": jnp.asarray(mask)}
 
 
+@pytest.mark.slow
 def test_bert_ring_attention_matches_xla():
   """Bidirectional ring attention on the encoder (long-context parity
   with GPT): logits match the xla-attention model on a seq mesh."""
@@ -445,6 +454,7 @@ def test_bert_smap_sequence_parallel_matches_sequential(impl):
       g1, g2)
 
 
+@pytest.mark.slow
 def test_bert_smap_ring_sparse_mask_matches_sequential():
   """Regression (review finding): ONE masked token per micro-batch —
   fewer than the seq-shard count.  The emit's div0 clamp must see the

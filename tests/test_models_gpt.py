@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 import easyparallellibrary_tpu as epl
 from easyparallellibrary_tpu.models import GPT, GPTConfig
@@ -87,6 +88,7 @@ def test_tensor_parallel_gpt_matches_dense():
   np.testing.assert_allclose(run(True), run(False), rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat():
   def run(remat):
     cfg = GPTConfig(vocab_size=64, num_layers=2, num_heads=4, d_model=32,
@@ -158,6 +160,7 @@ def test_generate_greedy_and_sampled():
     generate(model, params, jnp.zeros((1, 15), jnp.int32), 10)  # > max_seq
 
 
+@pytest.mark.slow
 def test_chunked_ce_matches_full_loss():
   """loss_chunk computes the identical loss/grads without materializing
   the [B, S, vocab] logits (round-1 NOTES bottleneck: vocab-32k head)."""

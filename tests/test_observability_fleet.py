@@ -664,3 +664,85 @@ def test_validate_trace_flow_negatives():
       {"ph": "f", "name": "flow", "ts": 3.0, "id": 7, **base},
       {"ph": "s", "name": "flow", "ts": 4.0, "id": 7, **base},
       {"ph": "f", "name": "flow", "ts": 5.0, "id": 7, **base}])
+
+
+# -------------------------------------------- virtual-clock discipline
+
+
+def test_slo_monitor_and_capture_follow_installed_vclock(tmp_path):
+  """The SLO layer's default clocks are utils/vclock seams consulted at
+  CALL time — install a virtual clock (what the fleet simulator and
+  the golden recorder do) and a config-built monitor stamps events, a
+  default-constructed DiagnosticCapture debounces, and bundle names
+  timestamp, all in SIMULATED seconds.  This is the contract replay
+  fidelity (tests/test_sim_replay.py) rests on: breach windows and
+  capture rate limits must not read the host's clocks behind the
+  episode's back."""
+  from easyparallellibrary_tpu.sim.engine import SimClock
+  from easyparallellibrary_tpu.utils import vclock
+  clk = SimClock()
+  clk.advance(1000.0)
+  vclock.install(clk)
+  try:
+    epl.init(epl.Config({"observability": {"slo": {
+        "enabled": True, "ttft_p99_s": 0.5}}}))
+    m = slo_lib.ensure_configured()
+    m.observe(1, {"serving/fleet/ttft_p99_s": 0.9})
+    assert m.breaches == 1
+    assert m.events[-1]["time"] == 1000.0       # sim seconds, not wall
+    clk.advance(7.0)
+    m.observe(2, {"serving/fleet/ttft_p99_s": 0.1})
+    assert m.recoveries == 1
+    assert m.events[-1]["time"] == 1007.0
+    cap = DiagnosticCapture(str(tmp_path), min_interval_s=30.0)
+    first = cap.capture("vclock")
+    assert first is not None
+    assert os.path.basename(first).startswith("bundle_1007_")
+    assert cap.capture("same-instant") is None  # debounced in sim time
+    assert cap.suppressed == 1
+    clk.advance(31.0)
+    assert cap.capture("later") is not None
+  finally:
+    vclock.reset()
+
+
+def test_burn_windows_fill_on_record_count_with_frozen_clock():
+  """Burn-rate windows are RECORD-count windows, not wall-time windows:
+  with the virtual clock frozen at 0 the breach still fires, exactly
+  when the slow window fills (slow_window + 1 cumulative records).
+  This count-driven property is what makes a fixed-dt replay's breach
+  timing deterministic."""
+  from easyparallellibrary_tpu.sim.engine import SimClock
+  from easyparallellibrary_tpu.utils import vclock
+  clk = SimClock()                 # never advanced
+  vclock.install(clk)
+  try:
+    rule = BurnRateRule("shed_burn", bad="shed",
+                        good="finished_requests", objective=0.9,
+                        fast_window=3, slow_window=6,
+                        fast_burn=1.0, slow_burn=1.0)
+    m = SLOMonitor([rule])
+    shed = good = 0
+    breach_at = None
+    for i in range(1, 12):
+      shed += 5
+      good += 5                    # 50% bad vs a 10% budget: burn 5x
+      m.observe(i, {"serving/fleet/shed": float(shed),
+                    "serving/fleet/finished_requests": float(good)})
+      if m.breaches and breach_at is None:
+        breach_at = i
+    assert breach_at == rule.slow_window + 1
+    assert m.events[-1]["time"] == 0.0          # frozen clock honored
+  finally:
+    vclock.reset()
+
+
+def test_slo_module_never_reads_host_clocks_directly():
+  """Source-level pin for the vclock discipline: every timestamp in
+  observability/slo.py must flow through utils/vclock (or an injected
+  clock), never a literal host-clock call — a single stray
+  time.time() would silently desynchronize simulated episodes."""
+  import inspect
+  src = inspect.getsource(slo_lib)
+  for banned in ("time.time(", "time.monotonic(", "time.perf_counter("):
+    assert banned not in src, banned

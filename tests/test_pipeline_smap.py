@@ -31,6 +31,7 @@ def _setup(M=4, S=2, num_layers=4, dropout=0.0, **kw):
 
 
 @pytest.mark.parametrize("S,M", [(2, 4), (2, 1), (4, 6)])
+@pytest.mark.slow
 def test_smap_gpt_matches_sequential(S, M):
   """smap-engine loss and gradients == autodiff through the sequential
   ground truth (same boxed params as every other pipeline path)."""
@@ -53,6 +54,7 @@ def test_smap_gpt_matches_sequential(S, M):
       g1, g2)
 
 
+@pytest.mark.slow
 def test_smap_gpt_uneven_stages_match_sequential():
   """5 layers over 2 stages: the masked slot is a real lax.cond branch
   per device, and numerics still match the sequential ground truth."""
@@ -157,6 +159,7 @@ def test_smap_share_scaling():
 
 
 @pytest.mark.parametrize("S,M", [(2, 4), (4, 6), (2, 1)])
+@pytest.mark.slow
 def test_smap_1f1b_matches_sequential(S, M):
   """The manual per-device 1F1B wavefront == sequential autodiff."""
   mesh, pp, base, ids, params = _setup(M=M, S=S)
@@ -192,6 +195,7 @@ def test_smap_1f1b_uneven_stages():
       g1, g2)
 
 
+@pytest.mark.slow
 def test_smap_1f1b_bounds_temp_bytes_vs_gpipe():
   """The residual ring bounds live activations: at M=8, S=4 the 1F1B
   wavefront's compiled temp bytes undercut the GPipe-order autodiff

@@ -84,6 +84,7 @@ def _gpt_setup(M=4, dropout=0.0, **kw):
   return mesh, pp, base, ids, params
 
 
+@pytest.mark.slow
 def test_gpt_1f1b_matches_autodiff():
   """1F1B GPT gradients == autodiff through the sequential ground truth."""
   mesh, pp, base, ids, params = _gpt_setup()
@@ -102,6 +103,7 @@ def test_gpt_1f1b_matches_autodiff():
       g1, g2)
 
 
+@pytest.mark.slow
 def test_gpt_1f1b_train_step_decreases_loss():
   """End-to-end: schedule dispatch + sharded training on the stage mesh."""
   from easyparallellibrary_tpu.parallel import (

@@ -46,6 +46,7 @@ def test_ring_matches_full(causal):
   np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_ring_grads_match_full():
   mesh = _seq_mesh(4)
   q, k, v = _qkv(seed=3)
@@ -125,6 +126,7 @@ def test_seq_sharded_batch_runs_on_seq_mesh():
   np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_seq_and_tensor_parallel_compose():
   """GPT on a seq2 x model2 x data2 mesh with ring attention + TP."""
   from easyparallellibrary_tpu.models import GPT, GPTConfig
@@ -309,6 +311,7 @@ def test_zigzag_noncausal_falls_back_to_contiguous():
   np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_unblockable_lengths_fall_back_to_einsum():
   """Sequence lengths with no power-of-two block divisor (e.g. 1030 =
   2*5*103 per device) must not raise or truncate: ring and Ulysses fall

@@ -662,7 +662,7 @@ def test_actuators_fault_free_bit_exact_zero_actuations():
   assert router._autoscaler is not None
   assert router._autoscaler.counters() == {
       "scale_ups": 0.0, "scale_downs": 0.0, "autoscale_holds": 0.0,
-      "flap_trips": 0.0}
+      "flap_trips": 0.0, "predictive_fires": 0.0}
   assert len(router.replicas) == 2
   for rep in router.replicas:
     tuner = rep.engine._autotuner

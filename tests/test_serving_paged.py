@@ -193,6 +193,7 @@ def test_block_pool_exhaustion_preempts_and_replays_exact():
   assert eng.scheduler.kv_blocks_free == 9
 
 
+@pytest.mark.slow
 def test_paged_speculative_bit_exact_both_drafters():
   """Greedy speculative paged decode keeps the oracle bitstream: drafts
   ride leftover flat-budget positions, verification gathers target rows

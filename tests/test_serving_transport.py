@@ -258,6 +258,7 @@ def test_inproc_transport_default_fault_free_bit_exact_zero_recompile():
 # ------------------------------------------ process transport: happy path
 
 
+@pytest.mark.slow
 def test_process_transport_serves_and_reaps_cleanly():
   prompts = _prompts(3)
   oracle = _oracle_outputs(prompts)
@@ -335,6 +336,7 @@ def test_process_sigkill_mid_decode_bit_exact_failover():
 # ------------------------------------- ambiguous timeouts: exactly-once
 
 
+@pytest.mark.slow
 def test_submit_reply_dropped_then_retried_admits_exactly_once():
   """The reply to a submit is lost AFTER the child admitted it; the
   transport's jittered-backoff retry resends; the child's uid dedup
@@ -366,6 +368,7 @@ def test_submit_reply_dropped_then_retried_admits_exactly_once():
   _assert_no_orphans([pid])
 
 
+@pytest.mark.slow
 def test_step_reply_lost_midflight_no_double_commit_on_replay():
   """A step reply vanishes mid-flight: the parent's journal watermark
   goes stale while the child committed tokens.  The replica is
