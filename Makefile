@@ -87,6 +87,16 @@ chaos-heal:
 chaos-rollout:
 	python -m pytest tests/test_serving_rollout.py -q
 
+# Front-door chaos: the streaming HTTP/SSE surface behind the reactor
+# driver (serving/frontdoor/, serving/reactor.py) — reactor-vs-sweep
+# bit-exactness pins, SSE byte-assembly vs direct submit(), real
+# SIGKILL/SIGSTOP of process replicas behind live HTTP clients (zero
+# lost, zero double-served), cancel-on-disconnect (slot + blocks freed,
+# flow finalized), slow-reader shed isolation (docs/serving.md
+# "Front door").
+chaos-frontdoor:
+	python -m pytest tests/test_serving_frontdoor.py -q
+
 # Continuous batching vs static-batch generate() under Poisson arrivals
 # (benchmarks/decode_throughput.py -> BENCH_EVIDENCE.json; docs/serving.md).
 serve-bench:
@@ -148,6 +158,14 @@ router-bench:
 	python benchmarks/router_failover.py
 	python benchmarks/router_failover.py --transport process
 
+# Front-door streaming latency: open-loop Poisson HTTP clients against
+# the live SSE listener, reactor vs sweep — time-to-first-streamed-
+# token p50/p99, inter-token-gap p99, tokens/s, zero lost + bit-exact
+# across drivers (benchmarks/frontdoor_bench.py -> BENCH_EVIDENCE.json
+# with hardware provenance; docs/serving.md "Front door").
+frontdoor-bench:
+	python benchmarks/frontdoor_bench.py
+
 # Cost-card fleet simulator: golden replay-fidelity check (the sim
 # must reproduce the recorded real-fleet chaos-heal actuation sequence
 # exactly), then 100-replica diurnal + overload sweeps and a
@@ -192,6 +210,7 @@ help:
 	@echo "  chaos-proc     - process-transport chaos: SIGKILL/SIGSTOP/lost replies/orphans"
 	@echo "  chaos-heal     - self-healing fleet: overload burst -> autotune + autoscale -> recover"
 	@echo "  chaos-rollout  - blue/green rollout chaos: SIGKILL a blue mid-canary, zero lost"
+	@echo "  chaos-frontdoor - HTTP/SSE front door chaos: disconnects, slow readers, kills behind the reactor"
 	@echo "  heal-bench     - actuators-on vs frozen fleet under the overload burst"
 	@echo "  rollout-bench  - blue/green rollout episode: 0 lost, 0 recompiles, blue bit-exact rollback"
 	@echo "  serve-bench    - continuous batching vs static generate()"
@@ -200,6 +219,7 @@ help:
 	@echo "  spec-bench     - speculative vs plain decode"
 	@echo "  overload-bench - admission control under Poisson overload"
 	@echo "  router-bench   - replica-kill failover episode (0 lost requests)"
+	@echo "  frontdoor-bench - SSE streaming latency: reactor vs sweep under Poisson HTTP load"
 	@echo "  sim-bench      - fleet simulator: replay fidelity + 100/1000-replica sweeps"
 	@echo "  sim-golden     - re-record the golden chaos-heal episode (real fleet)"
 	@echo "  trace-demo     - emit + validate a demo trace (fit/serving/failover)"
@@ -210,4 +230,4 @@ help:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test lint perf-gate gate bench chaos chaos-serve chaos-router chaos-proc chaos-heal chaos-rollout serve-bench paged-bench prefix-bench spec-bench overload-bench router-bench heal-bench rollout-bench sim-bench sim-golden trace-demo obs-bench help clean
+.PHONY: all build test lint perf-gate gate bench chaos chaos-serve chaos-router chaos-proc chaos-heal chaos-rollout chaos-frontdoor serve-bench paged-bench prefix-bench spec-bench overload-bench router-bench frontdoor-bench heal-bench rollout-bench sim-bench sim-golden trace-demo obs-bench help clean

@@ -555,6 +555,45 @@ class ServingConfig(_Category):
       # Deadline for a spawned child to import JAX, build its engine
       # from the factory, and answer the init frame.
       "router.spawn_timeout_s": 120.0,
+      # --- reactor router core (serving/reactor.py, docs/serving.md
+      # "Front door").  Readiness-driven dispatch: each live replica
+      # gets its next step the moment its previous reply lands
+      # (selectors over the process transport's socket; in-process
+      # replicas through a queue-backed readiness shim), so one slow
+      # replica no longer gates the fleet.  Consumed by router.run()
+      # and the front door's driver; router.step() stays the lock-step
+      # sweep either way (simulator / replay compatibility).
+      "router.reactor": False,
+      # Per-replica step quota inside one reactor cycle: a fast replica
+      # may run up to this many steps while a slow peer finishes one;
+      # control-plane actions (autoscale/rollout/drain/parked flush)
+      # still land only at cycle boundaries — the same mutation-safety
+      # contract as the sweep.
+      "router.reactor_max_steps": 4,
+      # --- streaming front door (serving/frontdoor/, docs/serving.md
+      # "Front door").  A stdlib HTTP/1.1 server exposing POST
+      # /v1/generate with SSE token streaming — tokens surface per
+      # engine iteration as they commit (scheduler.on_tokens), never
+      # by polling `finished` — plus per-connection backpressure and
+      # cancel-on-disconnect wired to the router's cancel(uid).
+      "frontdoor.host": "127.0.0.1",
+      # 0 = ephemeral: the OS picks a free port; FrontDoor.address
+      # reports the bound one (tests and the bench always use this).
+      "frontdoor.port": 0,
+      # Per-connection bounded buffer, in SSE token events: a slow
+      # reader's flow queues up to this many undelivered events, then
+      # its request is cancelled (finish_reason "cancelled", SSE
+      # `shed` terminal) — backpressure sheds ONLY that flow, never
+      # the fleet.
+      "frontdoor.stream_buffer": 64,
+      # Per-connection socket write deadline: a reader that keeps a
+      # write blocked this long is treated as disconnected (its flow
+      # cancelled), bounding a handler thread's stall.
+      "frontdoor.write_timeout_s": 10.0,
+      # SSE keepalive comment cadence while a stream is idle — also
+      # the cancel-on-disconnect probe: a dropped client surfaces as
+      # the keepalive write failing.
+      "frontdoor.keepalive_s": 2.0,
       # --- engine autotuner (serving/autotune.py, docs/robustness.md
       # "Self-healing fleet").  An SLO-breach-driven actuator that moves
       # DATA-VALUED knobs between fused steps — speculation-k clamp,
@@ -662,6 +701,10 @@ class ServingConfig(_Category):
   @property
   def router(self) -> _SubGroup:
     return _SubGroup(self, "router")
+
+  @property
+  def frontdoor(self) -> _SubGroup:
+    return _SubGroup(self, "frontdoor")
 
   @property
   def autotune(self) -> _SubGroup:
@@ -1095,6 +1138,22 @@ class Config:
     if router.spawn_timeout_s <= 0:
       raise ValueError(f"serving.router.spawn_timeout_s must be > 0; "
                        f"got {router.spawn_timeout_s}")
+    if router.reactor_max_steps < 1:
+      raise ValueError(f"serving.router.reactor_max_steps must be >= 1; "
+                       f"got {router.reactor_max_steps}")
+    frontdoor = self.serving.frontdoor
+    if not 0 <= frontdoor.port <= 65535:
+      raise ValueError(f"serving.frontdoor.port must be in [0, 65535]; "
+                       f"got {frontdoor.port}")
+    if frontdoor.stream_buffer < 1:
+      raise ValueError(f"serving.frontdoor.stream_buffer must be >= 1; "
+                       f"got {frontdoor.stream_buffer}")
+    if frontdoor.write_timeout_s <= 0:
+      raise ValueError(f"serving.frontdoor.write_timeout_s must be > 0; "
+                       f"got {frontdoor.write_timeout_s}")
+    if frontdoor.keepalive_s <= 0:
+      raise ValueError(f"serving.frontdoor.keepalive_s must be > 0; "
+                       f"got {frontdoor.keepalive_s}")
     if router.drain_timeout_s < 0:
       raise ValueError(f"serving.router.drain_timeout_s must be >= 0 "
                        f"(0 = migrate immediately); got "

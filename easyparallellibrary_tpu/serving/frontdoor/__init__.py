@@ -1,0 +1,20 @@
+"""Streaming HTTP front door for the serving fleet (docs/serving.md
+"Front door").
+
+``FrontDoor`` (server.py) owns a router on a single driver thread and
+exposes ``POST /v1/generate`` with Server-Sent-Events token streaming:
+tokens surface per engine iteration as they commit (the scheduler's
+``on_tokens`` hook — never by polling ``finished``), a slow reader
+bounds its own buffer and sheds/cancels only its own flow, and a client
+that disconnects mid-stream cancels its request (slot and cache blocks
+freed, flow trace finalized).  ``client.py`` is the stdlib SSE consumer
+the tests, benchmarks and chaos suite drive it with.  Stdlib only — no
+new dependencies.
+"""
+
+from easyparallellibrary_tpu.serving.frontdoor.server import FrontDoor
+from easyparallellibrary_tpu.serving.frontdoor.client import (
+    generate, healthz, open_raw_stream, stream_generate)
+
+__all__ = ["FrontDoor", "generate", "healthz", "open_raw_stream",
+           "stream_generate"]

@@ -124,6 +124,13 @@ class EngineReplica:
     return self.engine.has_work
 
   @property
+  def scheduler(self):
+    """The engine's scheduler — the subscriber-list hook point
+    (``on_admit``/``on_first_token``/``on_tokens``/``on_finish``) the
+    router's stream fanout and the sim fleet both attach to."""
+    return self.engine.scheduler
+
+  @property
   def finished(self) -> Dict[Any, FinishedRequest]:
     return self.engine.finished
 

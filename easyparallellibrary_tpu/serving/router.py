@@ -190,6 +190,21 @@ class Router:
     self._itl_slo = root_config.serving.resilience.itl_slo_s
     self.health: List[ReplicaHealth] = [
         self._make_health(i) for i in range(len(self.replicas))]
+    # Fleet-wide streamed-token fanout: fn(uid, [tok, ...]) fired per
+    # engine iteration as tokens COMMIT (scheduler.on_tokens for
+    # in-process replicas, the step reply's progress watermarks for
+    # process replicas) — the front door's feed (serving/frontdoor/).
+    # Subscribers must dedup by count across failover replays; the
+    # replay path pre-seeds the committed prefix, so fresh deltas
+    # continue the stream without re-emission.
+    self.on_tokens: List[Any] = []
+    for rep in self.replicas:
+      self._wire_stream(rep)
+    # Readiness-driven driver (serving/reactor.py), built lazily; the
+    # `serving.router.reactor` knob makes run() drive through it while
+    # step() stays the sweep (simulator / test compatibility).
+    self._reactor = None
+    self._reactor_enabled = bool(rconf.reactor)
     self.registry = registry
     if self._slo is not None and registry is not None:
       self._slo.attach(registry)
@@ -326,6 +341,7 @@ class Router:
     index = len(self.replicas)
     self.replicas.append(rep)
     self.health.append(self._make_health(index))
+    self._wire_stream(rep)
     tracer = trace_lib.get_tracer()
     if tracer.enabled:
       tracer.instant(
@@ -352,6 +368,34 @@ class Router:
     a fleet built from injected replicas (tests) — there is no recipe
     to build from."""
     return self.adopt_replica(self.build_replica())
+
+  def _wire_stream(self, rep) -> None:
+    """Attach the router's streamed-token fanout to one replica's hook
+    point: the parent-side ``on_tokens`` list for a process transport,
+    the scheduler's for an in-process one.  Duck-typed — injected fakes
+    without either hook simply don't stream (routing-policy tests)."""
+    hook = getattr(rep, "on_tokens", None)
+    if hook is None:
+      sched = getattr(rep, "scheduler", None)
+      hook = getattr(sched, "on_tokens", None) if sched is not None \
+          else None
+    if hook is not None:
+      hook.append(self._emit_tokens)
+
+  def _emit_tokens(self, uid: Any, tokens: List[int]) -> None:
+    for fn in self.on_tokens:
+      fn(uid, tokens)
+
+  def reactor(self):
+    """The readiness-driven driver over this fleet (built lazily,
+    serving/reactor.py): per-replica dispatch the moment each previous
+    reply lands, so one slow replica no longer gates the sweep.
+    ``run()`` drives through it when ``serving.router.reactor`` is on;
+    :meth:`step` stays the lock-step sweep either way."""
+    if self._reactor is None:
+      from easyparallellibrary_tpu.serving.reactor import RouterReactor
+      self._reactor = RouterReactor(self, config=self._root_config)
+    return self._reactor
 
   def _make_health_hook(self, index: int):
     def hook(old: str, new: str, reason: str):
@@ -635,14 +679,12 @@ class Router:
     self.finished[fin.uid] = fin
     self.placement.pop(fin.uid, None)
 
-  def step(self) -> List[FinishedRequest]:
-    """One fleet sweep: migrate expired drains, step every live replica
-    (collecting retirements and feeding health beats), fail over any
-    replica whose step raised or whose heartbeat aged out, and probe
-    down replicas whose breaker cooldown elapsed.  Returns this sweep's
-    retirements fleet-wide."""
-    now = self.clock()
-    out: List[FinishedRequest] = []
+  def _sweep_begin(self, now: float) -> None:
+    """Control-plane actions at a sweep/cycle boundary — the ONLY
+    point the replica list may mutate (autoscaler grow/drain, rollout
+    transitions, drain expiry, parked flush).  Shared verbatim by the
+    sweep :meth:`step` and the reactor's cycle (serving/reactor.py),
+    so both drivers honor the same mutation-safety contract."""
     if self.rollout is not None:
       # Rollout transitions land BEFORE the autoscaler acts: a rollback
       # or cutover this sweep must hold/release the autoscaler before
@@ -654,54 +696,63 @@ class Router:
       self._autoscaler.on_step(now)
     self._check_drains(now)
     self._flush_parked()
-    # Phase 1 — dispatch: process transports get their step frame NOW,
-    # so concurrent children overlap their sweeps (fleet wall-clock =
-    # the slowest child, not the sum); in-process replicas compute at
-    # collect time below, preserving the PR-8 execution order exactly.
-    stepped: List[int] = []
-    for i, rep in enumerate(self.replicas):
-      h = self.health[i]
-      if h.state == "down":
-        if h.can_probe(now):
-          self._probe(i)
-        continue
-      send = getattr(rep, "step_send", None)
-      if send is not None:
-        try:
-          send()
-        except Exception as e:  # noqa: BLE001 — dead at dispatch
-          self._note_step_death(i, e)
-          continue
-      stepped.append(i)
-    # Phase 2 — collect (and run, for in-process replicas), in replica
-    # order: retirements, health beats, failover of anything that died.
-    for i in stepped:
-      rep = self.replicas[i]
-      h = self.health[i]
-      recv = getattr(rep, "step_recv", None)
+
+  def _dispatch_one(self, i: int, now: float) -> bool:
+    """Phase-1 dispatch for one replica: post the step frame (process
+    transports) or mark it due (in-process replicas compute at
+    collect).  Down replicas are probed on the breaker cadence instead.
+    Returns True when the replica now owes a :meth:`_collect_one`."""
+    rep = self.replicas[i]
+    h = self.health[i]
+    if h.state == "down":
+      if h.can_probe(now):
+        self._probe(i)
+      return False
+    send = getattr(rep, "step_send", None)
+    if send is not None:
       try:
-        fins = rep.step() if recv is None else recv()
-      except Exception as e:  # noqa: BLE001 — ANY escaping error = dead
+        send()
+      except Exception as e:  # noqa: BLE001 — dead at dispatch
         self._note_step_death(i, e)
-        continue
-      for fin in fins:
-        self._note_finished(i, fin)
-        out.append(fin)
-      wire = getattr(rep, "wire_beat", None)
-      if wire:
-        # Process replica: the beat dict rode the step reply over the
-        # wire; same watermark semantics as the in-process signals.
-        h.beat_from_wire(wire)
-      else:
-        h.beat(watchdog_timeouts=rep.watchdog_timeouts,
-               bad_steps=rep.bad_steps, itl_s=rep.itl_ewma_s)
-      if h.state == "healthy" and h.trips:
-        # Breaker forgiveness: a rejoined replica that survives a full
-        # cooldown window clean sheds one trip.
-        since = self._rejoined_at.get(i, now)
-        if now - since >= h.cooldown_s():
-          h.note_stable()
-          self._rejoined_at[i] = now
+        return False
+    return True
+
+  def _collect_one(self, i: int,
+                   now: float) -> Optional[List[FinishedRequest]]:
+    """Phase-2 collect for one dispatched replica (and run, for
+    in-process replicas): retirements, the health beat, breaker
+    forgiveness.  Returns None when the replica died collecting (its
+    requests already failed over)."""
+    rep = self.replicas[i]
+    h = self.health[i]
+    recv = getattr(rep, "step_recv", None)
+    try:
+      fins = rep.step() if recv is None else recv()
+    except Exception as e:  # noqa: BLE001 — ANY escaping error = dead
+      self._note_step_death(i, e)
+      return None
+    for fin in fins:
+      self._note_finished(i, fin)
+    wire = getattr(rep, "wire_beat", None)
+    if wire:
+      # Process replica: the beat dict rode the step reply over the
+      # wire; same watermark semantics as the in-process signals.
+      h.beat_from_wire(wire)
+    else:
+      h.beat(watchdog_timeouts=rep.watchdog_timeouts,
+             bad_steps=rep.bad_steps, itl_s=rep.itl_ewma_s)
+    if h.state == "healthy" and h.trips:
+      # Breaker forgiveness: a rejoined replica that survives a full
+      # cooldown window clean sheds one trip.
+      since = self._rejoined_at.get(i, now)
+      if now - since >= h.cooldown_s():
+        h.note_stable()
+        self._rejoined_at[i] = now
+    return fins
+
+  def _sweep_end(self, now: float) -> None:
+    """Sweep/cycle epilogue: reap passively-down replicas, advance the
+    step counter, publish the rollup on the heartbeat cadence."""
     # A replica that reached "down" without raising (heartbeat aged out
     # at dispatch time between sweeps) is dead weight holding requests —
     # fail it over now.  Replicas that just stepped beat above, so their
@@ -716,6 +767,38 @@ class Router:
     if (self.registry is not None or self._slo is not None) and \
         self.clock() - self._last_rollup >= self._heartbeat_s:
       self._publish_rollup()
+
+  def step(self) -> List[FinishedRequest]:
+    """One fleet sweep: migrate expired drains, step every live replica
+    (collecting retirements and feeding health beats), fail over any
+    replica whose step raised or whose heartbeat aged out, and probe
+    down replicas whose breaker cooldown elapsed.  Returns this sweep's
+    retirements fleet-wide.
+
+    This is the lock-step (sweep-compat) driver — phase 1 dispatches to
+    every live replica, phase 2 collects in replica order — kept
+    byte-for-byte for the simulator and deterministic tests.  The
+    reactor (serving/reactor.py) drives the SAME four pieces
+    (``_sweep_begin`` / ``_dispatch_one`` / ``_collect_one`` /
+    ``_sweep_end``) readiness-first instead."""
+    now = self.clock()
+    out: List[FinishedRequest] = []
+    self._sweep_begin(now)
+    # Phase 1 — dispatch: process transports get their step frame NOW,
+    # so concurrent children overlap their sweeps (fleet wall-clock =
+    # the slowest child, not the sum); in-process replicas compute at
+    # collect time below, preserving the PR-8 execution order exactly.
+    stepped: List[int] = []
+    for i in range(len(self.replicas)):
+      if self._dispatch_one(i, now):
+        stepped.append(i)
+    # Phase 2 — collect (and run, for in-process replicas), in replica
+    # order: retirements, health beats, failover of anything that died.
+    for i in stepped:
+      fins = self._collect_one(i, now)
+      if fins:
+        out.extend(fins)
+    self._sweep_end(now)
     return out
 
   def _publish_rollup(self) -> None:
@@ -756,16 +839,13 @@ class Router:
     attached."""
     out: Dict[Any, np.ndarray] = {}
     steps = 0
+    drive = (self.reactor().cycle if self._reactor_enabled
+             else self.step)
     while self.has_work and (max_steps is None or steps < max_steps):
-      for fin in self.step():
+      for fin in drive():
         out[fin.uid] = fin.tokens
       steps += 1
-      if (self._parked
-          and not any(rep.has_work
-                      for i, rep in enumerate(self.replicas)
-                      if self.health[i].state != "down")
-          and not any(self._eligible_targets(s, self._survivors(-1))
-                      for s in self._parked)):
+      if self._parked_stalled():
         # The parked backlog cannot move (no healthy or suspect target
         # — or none of the pinned version) and no live replica has work
         # of its own to make progress on —
@@ -780,6 +860,17 @@ class Router:
     if self.registry is not None or self._slo is not None:
       self._publish_rollup()
     return out
+
+  def _parked_stalled(self) -> bool:
+    """True when the parked backlog cannot move and no live replica has
+    work of its own — run()'s (and the reactor's) spin guard."""
+    return bool(
+        self._parked
+        and not any(rep.has_work
+                    for i, rep in enumerate(self.replicas)
+                    if self.health[i].state != "down")
+        and not any(self._eligible_targets(s, self._survivors(-1))
+                    for s in self._parked))
 
   @property
   def has_work(self) -> bool:

@@ -495,6 +495,11 @@ class FCFSScheduler:
     self.on_admit: List[Callable[[Any], None]] = []      # fn(uid)
     self.on_first_token: List[Callable[[Any], None]] = []  # fn(uid)
     self.on_finish: List[Callable[[FinishedRequest], None]] = []
+    # Per-iteration token delivery: fn(uid, [tok, ...]) with the tokens
+    # THIS commit() appended for that request, fired the moment they
+    # commit (before any retirement they trigger) — the streaming front
+    # door's feed (serving/frontdoor/), so it never polls `finished`.
+    self.on_tokens: List[Callable[[Any, List[int]], None]] = []
 
   def _effective_budget(self) -> int:
     # Branches, not a list build: this runs twice per engine step on
@@ -1428,6 +1433,13 @@ class FCFSScheduler:
 
   # --------------------------------------------------------------- commit
 
+  def _emit_tokens(self, uid: Any, fresh: List[int]) -> None:
+    """Fan one request's just-committed tokens out to the ``on_tokens``
+    subscribers — always BEFORE any retirement those tokens trigger, so
+    a streaming consumer sees every token ahead of the finish event."""
+    for fn in self.on_tokens:
+      fn(uid, fresh)
+
   def _retire(self, state: _SlotState, reason: str) -> FinishedRequest:
     slot = state.slot
     del self.active[slot]
@@ -1526,15 +1538,26 @@ class FCFSScheduler:
         # position's logits ARE the distribution for new token number
         # len(generated) — identical to the undisturbed decode step
         # (tok_index fold included), so the stream continues bit-exactly.
+      fresh: List[int] = []
+      retired = False
       for j in range(int(num_committed[slot])):
         tok = int(tokens[slot, j])
         state.generated.append(tok)
+        fresh.append(tok)
         if req.stop_token >= 0 and tok == req.stop_token:
+          if self.on_tokens:
+            self._emit_tokens(req.uid, fresh)
           self._retire(state, "stop_token")
+          retired = True
           break
         if len(state.generated) >= req.max_new_tokens:
+          if self.on_tokens:
+            self._emit_tokens(req.uid, fresh)
           self._retire(state, "length")
+          retired = True
           break
+      if not retired and fresh and self.on_tokens:
+        self._emit_tokens(req.uid, fresh)
       # Decode watermark registration: committed tokens may have pushed
       # the written-K/V frontier across a block boundary — register the
       # freshly completed block(s).  A retirement above already

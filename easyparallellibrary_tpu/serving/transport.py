@@ -305,6 +305,22 @@ class ReplicaTransport:
   def step_recv(self) -> List[FinishedRequest]:
     raise NotImplementedError
 
+  def readiness_fd(self) -> Optional[int]:
+    """select()-able file descriptor that becomes readable when this
+    replica's pipelined step reply lands (the reactor's wait handle,
+    serving/reactor.py).  ``None`` = no wire: the replica computes
+    synchronously at :meth:`step_recv`, so the reactor treats it as
+    ready the moment it is dispatched (the queue-backed shim)."""
+    return None
+
+  def step_ready(self) -> bool:
+    """True when :meth:`step_recv` would return without blocking on the
+    wire.  In-process replicas are always ready (their compute happens
+    inside ``step_recv``); the process transport also reports ready
+    when the step reply was already drained off the socket by an
+    interleaved RPC (submit/cancel mid-cycle) and stashed."""
+    return True
+
   def rpc_counters(self) -> Dict[str, int]:
     return {"rpc_retries": 0, "rpc_timeouts": 0, "child_restarts": 0}
 
@@ -403,6 +419,12 @@ class ProcessTransport(ReplicaTransport):
     self.finished: Dict[Any, FinishedRequest] = {}
     self._finished_backlog: List[FinishedRequest] = []
     self.on_first_token: List[Callable[[Any], None]] = []
+    # Parent-side per-iteration token delivery: fn(uid, [tok, ...]) for
+    # every journal watermark advance — the child's scheduler commits
+    # ride the step reply's `progress` suffixes, so the wire already
+    # carries them; this fans the FRESH tokens (beyond what the parent
+    # had) out exactly once, mirroring how `first` -> on_first_token.
+    self.on_tokens: List[Callable[[Any, List[int]], None]] = []
     self.wire_beat: Optional[Dict[str, Any]] = None
     self.exit_signal: Optional[int] = None
     self.rpc_retries_total = 0
@@ -655,7 +677,15 @@ class ProcessTransport(ReplicaTransport):
       # Cumulative-watermark resync: the child sends the suffix from
       # the count the parent last acked; overlap overwrites (the
       # stream is deterministic, so overlapping tokens are identical).
+      prev = len(entry.generated)
       entry.generated[start:] = [int(t) for t in tokens]
+      if self.on_tokens and len(entry.generated) > prev:
+        # Stream delivery exactly once: only the tokens beyond what the
+        # journal already held are fresh — a stale frame's overlap
+        # re-applied above never re-fires (deterministic stream).
+        fresh = list(entry.generated[prev:])
+        for cb in self.on_tokens:
+          cb(uid, fresh)
     order = result.get("order")
     if order is not None:
       self._service_order = list(order)
@@ -761,6 +791,26 @@ class ProcessTransport(ReplicaTransport):
     if self._inflight_step is not None:
       return
     self._inflight_step = self._post("step", {"acked": self._acked()})
+
+  def readiness_fd(self) -> Optional[int]:
+    """The transport socket's fd while a step is in flight — readable
+    exactly when the child's reply (or any side-band frame) lands, which
+    is the reactor's dispatch-the-moment-it-answers signal."""
+    if self._inflight_step is None or self._sock is None \
+        or self._condemned:
+      return None
+    try:
+      return self._sock.fileno()
+    except OSError:
+      return None
+
+  def step_ready(self) -> bool:
+    """True when the pipelined step reply is already stashed (an
+    interleaved submit/cancel drained it off the socket while waiting
+    for its own reply) — the socket will never poll readable for it, so
+    the reactor must collect it directly."""
+    return (self._inflight_step is not None
+            and self._inflight_step in self._pending)
 
   def step_recv(self) -> List[FinishedRequest]:
     """Collect the pipelined step.  NEVER retried: a step is not

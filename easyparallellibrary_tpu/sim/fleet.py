@@ -95,7 +95,17 @@ def warm_fleet(router: Router, clock, prompt, warm_max_new: int) -> None:
     for i, rep in enumerate(router.replicas):
       rep.submit(Request(uid=f"warm{i}", prompt=prompt,
                          max_new_tokens=int(warm_max_new)))
-    router.run()
+    # Drain via the sweep EXPLICITLY, never router.run(): with
+    # `serving.router.reactor` on, run() delegates to the readiness
+    # driver (serving/reactor.py), whose cycles advance router.steps
+    # on a different cadence — and every recorded step index in a
+    # golden episode (tests/golden/sim_chaos_heal.json) is pinned to
+    # the sweep's.  The simulator is sweep-compat by contract
+    # (drive_episode below steps the same way).
+    while router.has_work:
+      router.step()
+    if router.registry is not None or router._slo is not None:
+      router._publish_rollup()
   finally:
     vclock.reset()
 

@@ -65,6 +65,18 @@ serving/transport.py's process-isolated replicas:
   the call but the parent never heard — that uid dedup and journal
   watermark resync must make exactly-once).
 
+Front-door client faults (`tests/test_serving_frontdoor.py`, `make
+chaos-frontdoor`) — the adversaries of serving/frontdoor/'s streaming
+HTTP surface, driven over REAL sockets against a live listener:
+
+* slow readers — :class:`SlowReader` (drains its SSE stream a byte at
+  a time with long pauses: the bounded per-connection queue must
+  overflow and shed ONLY that flow, never a neighbour's);
+* vanishing clients — :class:`DisconnectingClient` (consumes a few
+  token events then drops the connection — optionally with an RST
+  instead of a FIN: the front door must cancel the request, freeing
+  its slot and cache blocks, within one keepalive interval).
+
 These mutate real files, deliver real signals and poison real device
 calls; none of them are imported by library code.
 """
@@ -73,8 +85,12 @@ from __future__ import annotations
 
 import os
 import signal as _signal
+import socket as _socket
+import struct
+import threading
 import time
-from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, \
+    Sequence, Tuple
 
 import jax
 import numpy as np
@@ -598,6 +614,112 @@ def poisson_trace(rate_per_s: float, n: int, seed: int = 0,
   if first_at_zero:
     gaps[0] = 0.0
   return np.cumsum(gaps)
+
+
+class SlowReader(threading.Thread):
+  """A client too slow for its own stream: opens ``/v1/generate`` on a
+  live front door (serving/frontdoor/) over a raw socket, then drains
+  the SSE response ``read_bytes`` at a time with ``interval_s`` pauses
+  — far below token production rate, so the per-connection bounded
+  queue (``serving.frontdoor.stream_buffer``) must overflow and the
+  front door must shed THIS flow (cancel + ``done`` with reason
+  ``"cancelled"``) while neighbouring streams run untouched.
+
+  ``start()`` it, then ``join()``; afterwards ``bytes_read`` counts
+  what trickled through and ``eof`` records whether the server closed
+  the stream (it should — the shed's done event ends it)."""
+
+  def __init__(self, address: Tuple[str, int], body: Dict[str, Any],
+               headers: Optional[Dict[str, str]] = None,
+               read_bytes: int = 1, interval_s: float = 0.2,
+               duration_s: float = 30.0):
+    super().__init__(daemon=True)
+    self.address = address
+    self.body = body
+    self.headers = headers
+    self.read_bytes = int(read_bytes)
+    self.interval_s = float(interval_s)
+    self.duration_s = float(duration_s)
+    self.bytes_read = 0
+    self.eof = False
+    self.error: Optional[BaseException] = None
+
+  def run(self) -> None:
+    from easyparallellibrary_tpu.serving.frontdoor.client import (
+        open_raw_stream)
+    deadline = time.monotonic() + self.duration_s
+    try:
+      sock = open_raw_stream(self.address, self.body,
+                             headers=self.headers,
+                             timeout=self.duration_s)
+      try:
+        while time.monotonic() < deadline:
+          chunk = sock.recv(self.read_bytes)
+          if not chunk:
+            self.eof = True
+            return
+          self.bytes_read += len(chunk)
+          time.sleep(self.interval_s)
+      finally:
+        sock.close()
+    except OSError as e:
+      self.error = e
+
+
+class DisconnectingClient(threading.Thread):
+  """A client that vanishes mid-stream: consumes ``after_events`` SSE
+  token events from a live front door, then drops the connection —
+  with an RST (``rst=True``, SO_LINGER 0: the no-FIN vanish a flaky
+  mobile link produces) or a plain close.  The front door must cancel
+  the request within one keepalive interval: slot and cache blocks
+  freed, retirement reason ``"cancelled"``, trace flow finalized, and
+  no stats double-count.
+
+  After ``join()``: ``events_seen`` counts token events consumed before
+  the drop; ``dropped`` confirms the disconnect happened (vs the stream
+  finishing first)."""
+
+  def __init__(self, address: Tuple[str, int], body: Dict[str, Any],
+               headers: Optional[Dict[str, str]] = None,
+               after_events: int = 2, rst: bool = False,
+               timeout_s: float = 30.0):
+    super().__init__(daemon=True)
+    self.address = address
+    self.body = body
+    self.headers = headers
+    self.after_events = int(after_events)
+    self.rst = rst
+    self.timeout_s = float(timeout_s)
+    self.events_seen = 0
+    self.dropped = False
+    self.error: Optional[BaseException] = None
+
+  def run(self) -> None:
+    from easyparallellibrary_tpu.serving.frontdoor.client import (
+        open_raw_stream)
+    try:
+      sock = open_raw_stream(self.address, self.body,
+                             headers=self.headers,
+                             timeout=self.timeout_s)
+      buf = b""
+      try:
+        while self.events_seen < self.after_events:
+          chunk = sock.recv(4096)
+          if not chunk:
+            return                      # finished before we could drop
+          buf += chunk
+          self.events_seen = buf.count(b"event: token")
+        if self.rst:
+          # SO_LINGER 0: close() sends RST, not FIN — the server only
+          # discovers the corpse when a write (or keepalive probe)
+          # faults.
+          sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_LINGER,
+                          struct.pack("ii", 1, 0))
+        self.dropped = True
+      finally:
+        sock.close()
+    except OSError as e:
+      self.error = e
 
 
 def overload_burst(service_rate_per_s: float, n_burst: int,

@@ -291,6 +291,38 @@ def test_host_sync_covers_sim_modules(tmp_path):
   assert [f.line for f in findings] == [8]
 
 
+def test_host_sync_covers_frontdoor_and_reactor_modules(tmp_path):
+  """The event-driven front door (ISSUE 19) is hot-path for epl-lint:
+  the SHIPPED serving/reactor.py and serving/frontdoor/server.py scan
+  as hot (the reactor's dispatch/collect loop and the front door's
+  on_tokens fanout run per-replica-per-cycle and per-committed-token —
+  an implicit device->host fetch a future edit introduces there is a
+  finding, and the shipped baseline stays empty; the quick
+  zero-findings acceptance below enforces that), pinned against a
+  fixture twin so a marker refactor cannot silently drop them."""
+  from easyparallellibrary_tpu.analysis.core import ModuleInfo
+  from easyparallellibrary_tpu.analysis.rules import _is_hot
+  pkg = package_root()
+  for rel in ("serving/reactor.py", "serving/frontdoor/server.py",
+              "serving/frontdoor/client.py"):
+    shipped = os.path.join(pkg, rel)
+    assert os.path.exists(shipped)
+    assert _is_hot(ModuleInfo(path=shipped, rel=rel, source="",
+                              tree=None, parse_error=None)), rel
+  path = _write(tmp_path, "serving/frontdoor/server.py", """\
+      import jax
+      import numpy as np
+
+      _fn = jax.jit(lambda x: x)
+
+
+      def on_tokens(uid, toks):
+        return np.asarray(_fn(toks)).tolist()
+      """)
+  findings = _by_rule(_run(path), "host-sync")
+  assert [f.line for f in findings] == [8]
+
+
 def test_host_sync_flags_implicit_bool_and_float(tmp_path):
   _write(tmp_path, "runtime/loop.py", """\
       def fit(step_fn, state, batch):

@@ -50,3 +50,25 @@ def test_replay_is_itself_deterministic():
   b = replay_lib.replay(golden)
   assert a["sequence"] == b["sequence"]
   assert a["shed"] == b["shed"]
+
+
+def test_replay_unaffected_by_reactor_knob():
+  """ISSUE 19 regression: the simulator drives the fleet through the
+  sweep-compat ``router.step()`` path, so turning on the reactor
+  (``serving.router.reactor`` — the readiness-driven run()/front-door
+  driver, serving/reactor.py) must not perturb the golden episode:
+  the actuation sequence replays event-for-event identical."""
+  golden = replay_lib.load_golden()
+  baseline = replay_lib.replay(golden)
+  reactored = dict(golden)
+  reactored["config"] = {**golden["config"]}
+  serving = dict(reactored["config"].get("serving", {}))
+  serving["router"] = {**serving.get("router", {}), "reactor": True}
+  reactored["config"]["serving"] = serving
+  out = replay_lib.replay(reactored)
+  assert out["sequence"] == baseline["sequence"] == golden["sequence"]
+  assert out["shed"] == baseline["shed"]
+  assert out["busy_sweeps"] == baseline["busy_sweeps"]
+  assert out["breaches"] == baseline["breaches"]
+  assert out["recoveries"] == baseline["recoveries"]
+  assert out["replicas_peak"] == baseline["replicas_peak"]
