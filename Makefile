@@ -189,6 +189,15 @@ sim-golden:
 trace-demo:
 	python benchmarks/trace_demo.py
 
+# Two-replica PROCESS-transport fleet, one SIGKILL mid-decode -> ONE
+# merged multi-process trace (child rings harvested over the wire,
+# clock-rebased, schema-validated: failed-over requests are single
+# connected flows spanning parent + both child pids) + the latency
+# report (benchmarks/trace_fleet.py; docs/observability.md
+# "Distributed tracing").
+trace-fleet:
+	JAX_PLATFORMS=cpu python benchmarks/trace_fleet.py
+
 # Re-measure the observability layer's serving overhead (tracer + SLO
 # monitor + compile sentinel vs bare engine, interleaved per-step
 # samples) and append the <=5% evidence to BENCH_EVIDENCE.json
@@ -223,6 +232,7 @@ help:
 	@echo "  sim-bench      - fleet simulator: replay fidelity + 100/1000-replica sweeps"
 	@echo "  sim-golden     - re-record the golden chaos-heal episode (real fleet)"
 	@echo "  trace-demo     - emit + validate a demo trace (fit/serving/failover)"
+	@echo "  trace-fleet    - merged multi-process trace: SIGKILL episode over the wire"
 	@echo "  obs-bench      - tracer+SLO overhead evidence (<=5% budget)"
 	@echo "  clean          - clean native build artifacts"
 	@echo "Live watching: python -m easyparallellibrary_tpu.observability.report --follow <metrics.jsonl>"
@@ -230,4 +240,4 @@ help:
 clean:
 	$(MAKE) -C csrc clean
 
-.PHONY: all build test lint perf-gate gate bench chaos chaos-serve chaos-router chaos-proc chaos-heal chaos-rollout chaos-frontdoor serve-bench paged-bench prefix-bench spec-bench overload-bench router-bench frontdoor-bench heal-bench rollout-bench sim-bench sim-golden trace-demo obs-bench help clean
+.PHONY: all build test lint perf-gate gate bench chaos chaos-serve chaos-router chaos-proc chaos-heal chaos-rollout chaos-frontdoor serve-bench paged-bench prefix-bench spec-bench overload-bench router-bench frontdoor-bench heal-bench rollout-bench sim-bench sim-golden trace-demo trace-fleet obs-bench help clean

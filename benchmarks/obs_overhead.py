@@ -61,8 +61,11 @@ import _evidence  # noqa: E402  (the validated shared writer)
 METRIC = "observability_overhead"
 
 
-def _episode(eng, prompts, max_new):
-  """Serve the standard staggered mix once; per-step wall times."""
+def _episode(eng, prompts, max_new, per_step=None):
+  """Serve the standard staggered mix once; per-step wall times.
+  ``per_step`` (inside the timed window) models work that rides each
+  step in production — the harvest measurement passes the per-sweep
+  drain + ingest the cross-process path adds."""
   for i, p in enumerate(prompts[:2]):
     eng.submit(Request(uid=f"r{i}", prompt=p, max_new_tokens=max_new))
   steps = []
@@ -76,6 +79,8 @@ def _episode(eng, prompts, max_new):
       continue
     t0 = time.perf_counter()
     eng.step()
+    if per_step is not None:
+      per_step()
     steps.append(time.perf_counter() - t0)
   return steps
 
@@ -134,6 +139,37 @@ def run(episodes_per_side: int = 8, num_slots: int = 4, chunk: int = 8,
   on_med = statistics.median(times[True])
   off_med = statistics.median(times[False])
   on_min, off_min = min(times[True]), min(times[False])
+
+  # Cross-process harvest data path (ISSUE 20): tracer-on baseline vs
+  # tracer-on + per-step drain_wire + ingest_remote into a sink tracer
+  # — the added cost of one bounded sweep per step, measured without
+  # the wire (in production the chunk rides a step reply that already
+  # exists).  Same ABBA interleave, same engine on both sides.
+  sink = trace_lib.Tracer(ring_capacity=tracer.ring_capacity)
+  moved = [0]
+  sweep_bytes = int(
+      epl.Config({}).observability.harvest.max_bytes_per_sweep)
+
+  def _sweep():
+    chunk = tracer.drain_wire(sweep_bytes)
+    if chunk["events"]:
+      moved[0] += sink.ingest_remote(4242, chunk["events"],
+                                     offset_us=0.0)
+
+  htimes = {True: [], False: []}
+  gc.collect()
+  gc.disable()
+  try:
+    for harvest in [True, False, False, True] * episodes_per_side:
+      htimes[harvest].extend(_episode(
+          eng_on, prompts, max_new,
+          per_step=_sweep if harvest else None))
+  finally:
+    gc.enable()
+  h_med = statistics.median(htimes[True])
+  hoff_med = statistics.median(htimes[False])
+  h_min, hoff_min = min(htimes[True]), min(htimes[False])
+
   record = {
       "metric": METRIC,
       "backend": jax.default_backend(),
@@ -152,6 +188,15 @@ def run(episodes_per_side: int = 8, num_slots: int = 4, chunk: int = 8,
       # quick test's rationale).
       "within_5pct": (on_med <= off_med * 1.05 + 1e-4
                       or on_min <= off_min * 1.05 + 1e-4),
+      "harvest_step_ms": {"on_median": h_med * 1e3,
+                          "off_median": hoff_med * 1e3,
+                          "on_min": h_min * 1e3,
+                          "off_min": hoff_min * 1e3},
+      "harvest_overhead_frac_median": h_med / hoff_med - 1.0,
+      "harvest_overhead_frac_min": h_min / hoff_min - 1.0,
+      "harvest_within_5pct": (h_med <= hoff_med * 1.05 + 1e-4
+                              or h_min <= hoff_min * 1.05 + 1e-4),
+      "harvest_events_moved": moved[0],
       "fused_step_cache": {"on": eng_on._step_fn._cache_size(),
                            "off": eng_off._step_fn._cache_size()},
       "recompiles_flagged": eng_on._compile_sentinel.recompiles,

@@ -1,0 +1,111 @@
+"""Emit a MERGED multi-process trace: a two-replica process-transport
+fleet, one child SIGKILLed mid-decode, every surviving ring harvested
+over the wire into ONE Perfetto timeline.
+
+``make trace-fleet`` runs this on CPU: two ProcessTransport replicas —
+each a spawned subprocess owning its own JAX runtime and its own tracer
+ring — serve a batch of requests; ``os.kill(pid, SIGKILL)`` takes one
+down mid-decode; the router fails its requests over via prefix replay.
+Child spans reach the parent as bounded chunks riding step replies
+(docs/observability.md "Distributed tracing"), clock-rebased with the
+handshake offset estimate; the survivor's remainder is drained with the
+explicit ``harvest`` RPC and its final flush rides the shutdown reply.
+The script
+
+  * exports ONE merged Chrome-trace / Perfetto JSON
+    (``trace_fleet.json`` by default) in which the failed-over requests
+    are single connected flows spanning the parent and BOTH child pids,
+  * schema-validates it (``observability.trace.validate_trace`` — the
+    same validator the quick test in tests/test_observability_dist.py
+    runs: per-pid monotonic timestamps, strict span pairing including
+    the corpse's death-closed spans, every flow terminated), and
+  * prints the latency-breakdown report
+    (``python -m easyparallellibrary_tpu.observability.report``).
+
+Run: ``python benchmarks/trace_fleet.py [out.json]`` (or
+``make trace-fleet``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+  jax.config.update("jax_platforms", "cpu")
+
+FACTORY = {"fn": "easyparallellibrary_tpu.testing.factories:tiny_gpt"}
+
+
+def run_fleet_demo(out_path: str) -> str:
+  """Two process replicas, one SIGKILL, one merged trace; exports and
+  returns the trace path."""
+  import numpy as np
+
+  import easyparallellibrary_tpu as epl
+  from easyparallellibrary_tpu.observability import trace as trace_lib
+  from easyparallellibrary_tpu.serving import Request, Router
+  from easyparallellibrary_tpu.testing import chaos
+
+  config = epl.Config({
+      "serving": {"router": {"transport": "process",
+                             "rpc_timeout_s": 60.0,
+                             "rpc_retries": 2, "rpc_backoff_s": 0.05}},
+      "observability": {"enabled": True, "trace_path": out_path}})
+  epl.init(config)
+  tracer = trace_lib.ensure_configured()
+
+  r = np.random.RandomState(0)
+  prompts = [r.randint(0, 64, (6,)).astype(np.int32) for _ in range(6)]
+  router = Router(num_replicas=2, config=config, factory=FACTORY,
+                  num_slots=4, prefill_chunk=4)
+  pids = [rep.child_pid for rep in router.replicas]
+  for i, p in enumerate(prompts):
+    assert router.submit(Request(uid=i, prompt=p, max_new_tokens=10))
+  for _ in range(3):            # let decode get going on both children
+    router.step()
+  victim = router.replicas[0]
+  assert victim.has_work, "victim must die MID-decode, not idle"
+  chaos.ProcessKiller(victim).kill()
+  router.run()
+  assert router.failovers >= 1, "kill episode did not fail over"
+  assert set(router.finished) == set(range(len(prompts))), \
+      "zero lost requests"
+  harvested = router.harvest_traces()
+  counters = router.router_counters()
+  router.close()                # shutdown reply flushes the remainder
+  print(f"harvested {int(counters['trace_events_harvested'])} child "
+        f"events over the wire ({harvested} in the final sweep) from "
+        f"pids {pids}")
+  return tracer.export(out_path)
+
+
+def main(argv=None) -> int:
+  from easyparallellibrary_tpu.observability import report
+  from easyparallellibrary_tpu.observability.trace import validate_trace
+  argv = sys.argv[1:] if argv is None else argv
+  out = argv[0] if argv else "trace_fleet.json"
+  path = run_fleet_demo(out)
+  events = validate_trace(path)
+  pids = sorted({e["pid"] for e in events if e.get("ph") != "M"})
+  flows = {}
+  for ev in events:
+    if ev.get("ph") in ("s", "t", "f"):
+      flows.setdefault(ev["id"], set()).add(ev["pid"])
+  spanning = [fid for fid, fpids in flows.items() if len(fpids) >= 3]
+  assert spanning, \
+      "no failed-over flow spans the parent and both children"
+  print(f"merged trace OK: {len(events)} events across pids {pids}, "
+        f"{len(flows)} request flows ({len(spanning)} spanning parent "
+        f"+ both children) -> {path} (load at ui.perfetto.dev)\n")
+  print(report.format_report(report.load_events(path)))
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -821,6 +821,21 @@ class ObservabilityConfig(_Category):
       # Also dump every captured cost card to this JSON path (atomic
       # rewrite per capture; "" = memory only).
       "device.cards_path": "",
+      # --- Cross-process trace harvest (docs/observability.md
+      # "Distributed tracing").  With observability.enabled on a
+      # process-transport fleet, each child replica drains its tracer
+      # ring over the wire (bounded chunks piggybacked on step replies
+      # + a final flush on clean shutdown/evacuation) and the parent
+      # merges the rebased events into one Perfetto export.  Off keeps
+      # child rings child-local (the pre-distributed behaviour).
+      "harvest.enabled": True,
+      # Encoded-byte bound per harvest sweep: one step reply carries at
+      # most this many bytes of trace events, so harvest can never
+      # stall dispatch; the remainder rides later sweeps.
+      "harvest.max_bytes_per_sweep": 65536,
+      # Deadline for the explicit full-ring drain (`harvest` RPC loop)
+      # used by Router.harvest_traces() and the final flush paths.
+      "harvest.final_timeout_s": 5.0,
   }
 
   @property
@@ -830,6 +845,10 @@ class ObservabilityConfig(_Category):
   @property
   def device(self) -> _SubGroup:
     return _SubGroup(self, "device")
+
+  @property
+  def harvest(self) -> _SubGroup:
+    return _SubGroup(self, "harvest")
 
 
 class SimConfig(_Category):
@@ -1085,6 +1104,16 @@ class Config:
       raise ValueError(
           f"observability.slo.hbm_frac must be in [0, 1) (0 = rule "
           f"off); got {slo.hbm_frac}")
+    harvest = self.observability.harvest
+    if harvest.max_bytes_per_sweep < 1024:
+      raise ValueError(
+          f"observability.harvest.max_bytes_per_sweep must be >= 1024 "
+          f"(one sweep must fit at least a few events); got "
+          f"{harvest.max_bytes_per_sweep}")
+    if harvest.final_timeout_s <= 0:
+      raise ValueError(
+          f"observability.harvest.final_timeout_s must be > 0; got "
+          f"{harvest.final_timeout_s}")
     if spec.enabled and spec.k + 1 > self.serving.prefill_chunk:
       raise ValueError(
           f"serving.speculative.k={spec.k} needs serving.prefill_chunk "

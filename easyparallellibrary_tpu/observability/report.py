@@ -8,7 +8,9 @@ prints, without leaving the terminal for Perfetto:
 * **request timelines** — per serving request: queue wait, prefill
   time/chunks, decode steps, speculation drafted/accepted, TTFT,
   total latency and finish reason (where did THIS request's latency
-  go);
+  go); merged multi-process traces with front-door instrumentation
+  add the hop decomposition — client-observed TTFT, ingress and wire
+  columns (docs/observability.md "Distributed tracing");
 * with ``--metrics <metrics.jsonl>``, the **fleet rollup** — the last
   ``serving/fleet/*`` record a multi-replica Router published through
   the registry (tokens/s summed, merged TTFT/ITL percentiles,
@@ -53,7 +55,10 @@ def load_events(path: str) -> List[Dict[str, Any]]:
 def pair_spans(events: List[Dict[str, Any]]
                ) -> Tuple[List[Dict[str, Any]], int]:
   """Match B/E pairs per (pid, tid) into completed spans
-  ``{name, cat, ts, dur, tid, args}``; returns (spans, unmatched)."""
+  ``{name, cat, ts, dur, pid, tid, args}``; returns (spans, unmatched).
+  Merged multi-process traces (docs/observability.md "Distributed
+  tracing") interleave pids, so the pid rides along — timeline
+  containment checks must key on (pid, tid), not tid alone."""
   spans: List[Dict[str, Any]] = []
   unmatched = 0
   stacks: Dict[Tuple[Any, Any], List[Dict[str, Any]]] = {}
@@ -72,7 +77,7 @@ def pair_spans(events: List[Dict[str, Any]]
     args.update(ev.get("args") or {})
     spans.append({"name": b["name"], "cat": b.get("cat", ""),
                   "ts": b["ts"], "dur": ev["ts"] - b["ts"],
-                  "tid": key[1], "args": args})
+                  "pid": key[0], "tid": key[1], "args": args})
   unmatched += sum(len(s) for s in stacks.values())
   return spans, unmatched
 
@@ -101,10 +106,20 @@ def request_timelines(events: List[Dict[str, Any]]
   plus the resilience events (docs/robustness.md "Serving resilience"):
   per-uid requeue counts, and rows for requests that never reached a
   slot (shed at submit, expired or cancelled in the queue), whose whole
-  story is an instant."""
+  story is an instant.
+
+  On a merged multi-process trace with front-door instrumentation the
+  rows also carry the hop decomposition (docs/observability.md
+  "Distributed tracing"): ``ingress_us`` (front-door receipt to router
+  submit), ``client_ttft_us`` (front-door receipt to first SSE byte —
+  the latency the CLIENT observed) and ``wire_us`` (engine first token
+  to first SSE byte: harvest-rebased wire + stream-delivery gap; small
+  negatives are clock-offset noise and reported as-is)."""
   spans, _ = pair_spans(events)
   submits: Dict[str, float] = {}
   first_tokens: Dict[str, float] = {}
+  fd_requests: Dict[str, float] = {}
+  fd_first_bytes: Dict[str, float] = {}
   requeues: Dict[str, int] = {}
   # Requests resolved without ever holding a slot: uid -> (ts, reason).
   unadmitted: Dict[str, Tuple[float, str]] = {}
@@ -122,6 +137,10 @@ def request_timelines(events: List[Dict[str, Any]]
       first_tokens[uid] = ev["ts"]
     elif name == "serving/requeue":
       requeues[uid] = requeues.get(uid, 0) + 1
+    elif name == "frontdoor/request":
+      fd_requests[uid] = ev["ts"]
+    elif name == "frontdoor/first_byte":
+      fd_first_bytes[uid] = ev["ts"]
     elif name == "serving/shed":
       unadmitted[uid] = (ev["ts"], "shed")
     elif name in ("serving/deadline", "serving/cancelled"):
@@ -133,7 +152,8 @@ def request_timelines(events: List[Dict[str, Any]]
     uid = str(req["args"].get("uid", req["name"]))
     t0, t1 = req["ts"], req["ts"] + req["dur"]
     inner = [s for s in spans
-             if s["tid"] == req["tid"] and s["name"] != req["name"]
+             if s["pid"] == req["pid"] and s["tid"] == req["tid"]
+             and s["name"] != req["name"]
              and t0 <= s["ts"] and s["ts"] + s["dur"] <= t1 + 1e-9]
     phase_us = {ph: sum(s["dur"] for s in inner if s["name"] == ph)
                 for ph in ("prefill", "decode", "speculate")}
@@ -148,9 +168,18 @@ def request_timelines(events: List[Dict[str, Any]]
         (s["args"].get("kv_blocks", 0) for s in inner), default=0)
     submit = submits.get(uid)
     ttft = first_tokens.get(uid)
+    fd_req = fd_requests.get(uid)
+    fd_byte = fd_first_bytes.get(uid)
     requests.append({
         "uid": uid,
         "queue_wait_us": (t0 - submit) if submit is not None else None,
+        "ingress_us": (submit - fd_req)
+                      if None not in (submit, fd_req) else None,
+        "client_ttft_us": (fd_byte - fd_req)
+                          if None not in (fd_byte, fd_req) else None,
+        "wire_us": (fd_byte - first_tokens[uid])
+                   if fd_byte is not None and uid in first_tokens
+                   else None,
         "admitted_ts_us": t0,
         "total_us": req["dur"],
         "ttft_us": (ttft - (submit if submit is not None else t0))
@@ -179,9 +208,13 @@ def request_timelines(events: List[Dict[str, Any]]
     if uid in resolved_in_slot:
       continue
     submit = submits.get(uid)
+    fd_req = fd_requests.get(uid)
     requests.append({
         "uid": uid,
         "queue_wait_us": (ts - submit) if submit is not None else None,
+        "ingress_us": (submit - fd_req)
+                      if None not in (submit, fd_req) else None,
+        "client_ttft_us": None, "wire_us": None,
         "admitted_ts_us": ts,
         "total_us": None, "ttft_us": None,
         "prefill_us": 0.0, "prefill_chunks": 0,
@@ -454,7 +487,16 @@ def format_report(events: List[Dict[str, Any]]) -> str:
     # Same shape-preservation rule for blk-reused: it only appears when
     # the prefix cache actually mapped shared blocks into some request.
     reuse = any(r["blk_reused"] for r in requests)
-    lines.append(f"{'request':<12}{'wait':>9}{'ttft':>10}{'prefill':>10}"
+    # Hop columns (fd-ttft = client-observed TTFT, wire = engine first
+    # token -> first SSE byte) only appear when the trace actually
+    # carries front-door instants — an engine-only trace keeps its
+    # old shape.
+    hops = any(r["client_ttft_us"] is not None
+               or r["ingress_us"] is not None for r in requests)
+    lines.append(f"{'request':<12}{'wait':>9}{'ttft':>10}"
+                 + (f"{'fd-ttft':>9}{'ingress':>9}{'wire':>9}"
+                    if hops else "")
+                 + f"{'prefill':>10}"
                  f"{'chunks':>7}{'decode':>10}{'steps':>6}{'drafted':>8}"
                  f"{'accepted':>9}{'rq':>4}"
                  + (f"{'blk':>5}" if paged else "")
@@ -463,7 +505,11 @@ def format_report(events: List[Dict[str, Any]]) -> str:
     for r in requests:
       lines.append(
           f"{r['uid']:<12}{_fmt_us(r['queue_wait_us']):>9}"
-          f"{_fmt_us(r['ttft_us']):>10}{_fmt_us(r['prefill_us']):>10}"
+          f"{_fmt_us(r['ttft_us']):>10}"
+          + (f"{_fmt_us(r['client_ttft_us']):>9}"
+             f"{_fmt_us(r['ingress_us']):>9}"
+             f"{_fmt_us(r['wire_us']):>9}" if hops else "")
+          + f"{_fmt_us(r['prefill_us']):>10}"
           f"{r['prefill_chunks']:>7}{_fmt_us(r['decode_us']):>10}"
           f"{r['decode_steps']:>6}{r['drafted']:>8}{r['accepted']:>9}"
           f"{r['requeues']:>4}"

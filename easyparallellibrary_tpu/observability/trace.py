@@ -43,6 +43,18 @@ Design constraints, in order:
    process 0 writes the JSON — the metrics writers' rule
    (epl/parallel/hooks.py:542).
 
+**Distributed tracing** (docs/observability.md "Distributed
+tracing"): a process-isolated replica records into its OWN ring; the
+parent harvests it over the wire in bounded increments
+(:meth:`Tracer.drain_wire` child-side, :meth:`Tracer.ingest_remote`
+parent-side) and rebases the child's timestamps into its timebase with
+a handshake-estimated clock offset (midpoint of send/recv
+``perf_counter_ns`` pairs).  The merged export tags each process's
+events with its OS pid, emits per-pid process/track metadata, and
+keeps every pid's timeline monotonic after shifting — so one Perfetto
+file shows the whole fleet and a request flow arcs across process
+boundaries.
+
 The export is standard Chrome trace-event JSON: load it at
 ``ui.perfetto.dev`` or ``chrome://tracing``.  Device-side XLA timelines
 are attached with :meth:`Tracer.xla_trace`, which brackets a
@@ -63,6 +75,12 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 # Event tuples in the ring: (ph, name, cat, ts_us, tid, args_or_None).
 # Dicts are only built at export — the hot path appends one tuple.
 _Event = Tuple[str, str, str, float, int, Optional[Dict[str, Any]]]
+
+# Wire event shape for cross-process harvest (JSON-friendly lists):
+# [ph, name, cat, ts_us, track_name, args_or_None].  Track NAMES cross
+# the wire — tids are tracer-local and get re-assigned per remote pid
+# on ingest, so two processes' "serving/slot0" tracks never collide.
+_ENC = {"separators": (",", ":"), "default": str}
 
 
 class _NullSpan:
@@ -143,12 +161,27 @@ class Tracer:
     # Eviction accounting off the hot path: one int increment per
     # append; `dropped` is derived at read time.
     self._n_appended = 0
+    # Harvest accounting: events consumed by drain_wire() are delivered,
+    # not dropped.
+    self._n_drained = 0
+    # Harvested remote rings, keyed by the remote OS pid.  Each store
+    # holds its own ring (bounded like the local one), its own track
+    # table (track names -> per-pid tids), the display label, and the
+    # last rebased timestamp (per-process monotonic clamp: a re-sampled
+    # clock offset may move backwards; the merged timeline must not).
+    self._remote: Dict[int, Dict[str, Any]] = {}
 
   # ------------------------------------------------------------- recording
 
   def now_us(self) -> float:
     """Microseconds since tracer creation (host monotonic clock)."""
     return (time.perf_counter_ns() - self._t0_ns) / 1e3
+
+  def at_us(self, t_ns: int) -> float:
+    """A raw ``time.perf_counter_ns`` reading in this tracer's µs
+    timebase (clock-offset estimation uses send/recv timestamps taken
+    OUTSIDE the tracer)."""
+    return (t_ns - self._t0_ns) / 1e3
 
   def track(self, name: Optional[str]) -> int:
     """tid for a named track (registered on first use; exported as a
@@ -165,9 +198,16 @@ class Tracer:
     return tid
 
   @property
+  def pending(self) -> int:
+    """Events currently buffered in the local ring (the harvest loop's
+    'drained dry' signal)."""
+    return len(self._events)
+
+  @property
   def dropped(self) -> int:
-    """Events evicted by the ring so far (for the export note)."""
-    return self._n_appended - len(self._events)
+    """Events evicted by the ring so far (for the export note).
+    Events consumed by :meth:`drain_wire` were delivered, not lost."""
+    return self._n_appended - self._n_drained - len(self._events)
 
   def _append(self, ph: str, name: str, cat: str, ts: float, tid: int,
               args: Optional[Dict[str, Any]]):
@@ -284,27 +324,162 @@ class Tracer:
                    args={"log_dir": os.path.abspath(log_dir)})
       get_logger().info("xla trace written to %s", log_dir)
 
+  # ------------------------------------------- cross-process harvest --
+
+  def drain_wire(self, max_bytes: Optional[int] = None
+                 ) -> Dict[str, Any]:
+    """Consume the OLDEST ring events into a wire-ready chunk of at
+    most ~``max_bytes`` encoded bytes (``None`` = drain everything).
+    Called in a worker's serve loop so the parent can harvest the ring
+    incrementally; the byte bound keeps one sweep from ever stalling
+    dispatch, and whatever does not fit simply rides a later sweep.
+    Returns ``{"events": [[ph, name, cat, ts_us, track, args], ...],
+    "now_us": <child clock>, "dropped": <ring evictions so far>}``.
+    Drained events are delivered, not dropped — :attr:`dropped` only
+    counts ring evictions."""
+    out: List[List[Any]] = []
+    size = 0
+    with self._lock:
+      rev = {tid: name for name, tid in self._tracks.items()}
+      while self._events:
+        ph, name, cat, ts, tid, args = self._events[0]
+        wire = [ph, name, cat, ts, rev.get(tid, f"track{tid}"), args]
+        enc = len(json.dumps(wire, **_ENC))
+        if out and max_bytes is not None and size + enc > max_bytes:
+          break
+        self._events.popleft()
+        self._n_drained += 1
+        out.append(wire)
+        size += enc
+        if max_bytes is not None and size >= max_bytes:
+          break
+    return {"events": out, "now_us": self.now_us(),
+            "dropped": self.dropped}
+
+  def ingest_remote(self, pid: int, events: List[List[Any]], *,
+                    offset_us: float, label: str = "") -> int:
+    """Merge a harvested chunk from a remote process into this tracer.
+
+    ``pid`` is the remote OS pid (the merged export's process key),
+    ``offset_us`` the current clock-offset estimate such that
+    ``parent_ts ≈ child_ts + offset_us``.  Rebased timestamps are
+    clamped per-pid monotonic: the offset is re-estimated over time and
+    may step backwards, but a process's own clock never does, so the
+    merged timeline must not either.  Remote rings are bounded like the
+    local one.  Returns the number of events ingested."""
+    if not events:
+      return 0
+    n = 0
+    with self._lock:
+      store = self._remote.get(pid)
+      if store is None:
+        store = {"label": label or f"pid {pid}",
+                 "tracks": {},
+                 "events": deque(maxlen=self.ring_capacity),
+                 "appended": 0,
+                 "last_ts": None}
+        self._remote[pid] = store
+      elif label:
+        store["label"] = label
+      tracks = store["tracks"]
+      for wire in events:
+        try:
+          ph, name, cat, ts, track, args = wire
+        except (TypeError, ValueError):
+          continue  # malformed wire event: drop, never poison the ring
+        tid = tracks.get(track)
+        if tid is None:
+          tid = len(tracks)
+          tracks[track] = tid
+        ts = float(ts) + offset_us
+        last = store["last_ts"]
+        if last is not None and ts < last:
+          ts = last
+        store["last_ts"] = ts
+        store["events"].append((ph, name, cat, ts, tid, args))
+        store["appended"] += 1
+        n += 1
+    return n
+
+  def close_remote(self, pid: int, reason: str = "lost") -> int:
+    """Close every span a remote process left OPEN — a SIGKILLed child
+    dies mid-request, so its harvested ring ends in dangling ``B``
+    events that would fail schema validation and render as unbounded
+    slices.  Synthesizes ``E`` events at the pid's last rebased
+    timestamp (LIFO per track, tagged ``{"finish_reason": reason}``),
+    so the merged trace shows the victim's work ENDING at death.
+    Idempotent; returns the number of spans closed."""
+    with self._lock:
+      store = self._remote.get(pid)
+      if store is None or store["last_ts"] is None:
+        return 0
+      open_spans: Dict[int, List[Tuple[str, str]]] = {}
+      for ph, name, cat, _ts, tid, _args in store["events"]:
+        if ph == "B":
+          open_spans.setdefault(tid, []).append((name, cat))
+        elif ph == "E":
+          stack = open_spans.get(tid)
+          if stack and stack[-1][0] == name:
+            stack.pop()
+      n = 0
+      for tid, stack in open_spans.items():
+        while stack:
+          name, cat = stack.pop()
+          store["events"].append(
+              ("E", name, cat, store["last_ts"], tid,
+               {"finish_reason": reason}))
+          store["appended"] += 1
+          n += 1
+      return n
+
+  def remote_summary(self) -> Dict[int, Dict[str, Any]]:
+    """Per remote pid: display label, events currently buffered, and
+    events evicted from the remote ring (diagnostics + tests)."""
+    with self._lock:
+      return {pid: {"label": s["label"], "events": len(s["events"]),
+                    "dropped": s["appended"] - len(s["events"])}
+              for pid, s in self._remote.items()}
+
   # --------------------------------------------------------------- export
 
   def events(self) -> List[Dict[str, Any]]:
-    """Chrome-trace-event dicts: thread-name metadata first, then the
-    ring's events sorted by timestamp (spans recorded retroactively via
-    :meth:`span_at` land in buffer order, not time order; the stable
-    sort restores B-before-E at equal timestamps)."""
+    """Chrome-trace-event dicts: per-process metadata first (process
+    and thread names for the local pid and every harvested remote pid),
+    then ALL processes' events merged and sorted by timestamp (spans
+    recorded retroactively via :meth:`span_at` land in buffer order,
+    not time order; the stable sort restores B-before-E at equal
+    timestamps, and each pid's stream is already monotonic so the
+    merge preserves per-pid order)."""
     import jax
     pid = jax.process_index()
     with self._lock:  # a concurrent append must not mutate mid-snapshot
       events = list(self._events)
       tracks = sorted(self._tracks.items(), key=lambda kv: kv[1])
+      remote = [(rpid, s["label"],
+                 sorted(s["tracks"].items(), key=lambda kv: kv[1]),
+                 list(s["events"]))
+                for rpid, s in sorted(self._remote.items())]
     out: List[Dict[str, Any]] = []
     for name, tid in tracks:
       out.append({"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
                   "args": {"name": name}})
       out.append({"ph": "M", "name": "thread_sort_index", "pid": pid,
                   "tid": tid, "args": {"sort_index": tid}})
-    for ph, name, cat, ts, tid, args in sorted(events, key=lambda e: e[3]):
+    for rpid, label, rtracks, _revents in remote:
+      out.append({"ph": "M", "name": "process_name", "pid": rpid,
+                  "tid": 0, "args": {"name": label}})
+      for name, tid in rtracks:
+        out.append({"ph": "M", "name": "thread_name", "pid": rpid,
+                    "tid": tid, "args": {"name": name}})
+        out.append({"ph": "M", "name": "thread_sort_index", "pid": rpid,
+                    "tid": tid, "args": {"sort_index": tid}})
+    merged = [(e, pid) for e in events]
+    for rpid, _label, _rtracks, revents in remote:
+      merged.extend((e, rpid) for e in revents)
+    for (ph, name, cat, ts, tid, args), epid in sorted(
+        merged, key=lambda e: e[0][3]):
       ev: Dict[str, Any] = {"ph": ph, "name": name, "ts": ts,
-                            "pid": pid, "tid": tid}
+                            "pid": epid, "tid": tid}
       if cat:
         ev["cat"] = cat
       if ph == "i":
@@ -355,6 +530,8 @@ class Tracer:
     with self._lock:
       self._events.clear()
       self._n_appended = 0
+      self._n_drained = 0
+      self._remote.clear()
 
 
 # ------------------------------------------------------- global tracer --
@@ -443,14 +620,21 @@ def validate_trace(trace: Union[str, Dict[str, Any], List[Dict[str, Any]]]
   or raises ``ValueError`` naming every problem.
 
   Checks: top-level shape, required keys per event, monotonically
-  non-decreasing ``ts``, strict B/E pairing per (pid, tid) — every
-  E closes the innermost open B of the same name, nothing left open —
-  and the flow schema: every ``s``/``t``/``f`` flow event carries an
-  ``id``, steps and finishes follow a start of the same id, no second
-  start while a flow is open, and every started flow TERMINATES with an
-  ``f`` (a failed-over request must reach retirement somewhere —
-  a dangling flow is a lost request).  (``make trace-demo``'s quick
-  test runs this over a real emitted trace.)
+  non-decreasing ``ts`` PER PID (a merged multi-process trace
+  interleaves processes whose clocks are only offset-aligned; each
+  process's own rebased timeline must still be monotonic), unique
+  thread-name metadata per (pid, tid) — a merge bug that emits a pid's
+  track table twice corrupts Perfetto's row labels — strict B/E
+  pairing per (pid, tid) — every E closes the innermost open B of the
+  same name, nothing left open — and the flow schema: every
+  ``s``/``t``/``f`` flow event carries an ``id``, steps and finishes
+  follow a start of the same id AND bind to it by category (viewers
+  match flows by cat + id, so a cross-process arc only connects when
+  both sides agree), no second start while a flow is open, and every
+  started flow TERMINATES with an ``f`` (a failed-over request must
+  reach retirement somewhere — a dangling flow is a lost request).
+  (``make trace-demo`` / ``make trace-fleet`` quick tests run this
+  over real emitted traces.)
   """
   if isinstance(trace, str):
     with open(trace) as f:
@@ -464,10 +648,11 @@ def validate_trace(trace: Union[str, Dict[str, Any], List[Dict[str, Any]]]
   if not isinstance(events, list):
     raise ValueError(f"traceEvents must be a list; got {type(events)}")
   problems: List[str] = []
-  last_ts: Optional[float] = None
+  last_ts: Dict[Any, float] = {}
   stacks: Dict[Tuple[Any, Any], List[str]] = {}
-  # Open flows: id -> index of the "s" event (for the error message).
-  flows: Dict[Any, int] = {}
+  named_tracks: set = set()
+  # Open flows: id -> (index of the "s" event, its category).
+  flows: Dict[Any, Tuple[int, Any]] = {}
   for i, ev in enumerate(events):
     if not isinstance(ev, dict):
       problems.append(f"event {i}: not an object")
@@ -477,17 +662,25 @@ def validate_trace(trace: Union[str, Dict[str, Any], List[Dict[str, Any]]]
       problems.append(f"event {i}: missing {missing}")
       continue
     ph = ev["ph"]
+    pid = ev["pid"]
     if ph == "M":
+      if ev["name"] == "thread_name":
+        key = (pid, ev["tid"])
+        if key in named_tracks:
+          problems.append(f"event {i}: duplicate thread_name metadata "
+                          f"for pid/tid {key}")
+        named_tracks.add(key)
       continue  # metadata events carry no timestamp
     if "ts" not in ev:
       problems.append(f"event {i} ({ph} {ev['name']!r}): missing 'ts'")
       continue
     ts = ev["ts"]
-    if last_ts is not None and ts < last_ts:
+    prev = last_ts.get(pid)
+    if prev is not None and ts < prev:
       problems.append(
-          f"event {i} ({ph} {ev['name']!r}): ts {ts} < previous {last_ts} "
-          f"(not monotonic)")
-    last_ts = ts
+          f"event {i} ({ph} {ev['name']!r}): ts {ts} < previous {prev} "
+          f"on pid {pid} (not monotonic)")
+    last_ts[pid] = ts
     if ph in ("s", "t", "f"):
       if "id" not in ev:
         problems.append(f"event {i} ({ph} {ev['name']!r}): flow event "
@@ -498,13 +691,20 @@ def validate_trace(trace: Union[str, Dict[str, Any], List[Dict[str, Any]]]
         if fid in flows:
           problems.append(
               f"event {i}: flow {fid!r} started again while still open "
-              f"(previous start at event {flows[fid]})")
-        flows[fid] = i
+              f"(previous start at event {flows[fid][0]})")
+        flows[fid] = (i, ev.get("cat"))
       elif fid not in flows:
         problems.append(f"event {i}: flow {ph!r} phase for {fid!r} with "
                         f"no open flow start")
-      elif ph == "f":
-        del flows[fid]
+      else:
+        start_cat = flows[fid][1]
+        if ev.get("cat") != start_cat:
+          problems.append(
+              f"event {i}: flow {ph!r} for {fid!r} on pid {pid} has cat "
+              f"{ev.get('cat')!r} but the flow started with "
+              f"{start_cat!r} (flows bind by cat + id)")
+        if ph == "f":
+          del flows[fid]
       continue
     key = (ev["pid"], ev["tid"])
     stack = stacks.setdefault(key, [])
@@ -524,7 +724,7 @@ def validate_trace(trace: Union[str, Dict[str, Any], List[Dict[str, Any]]]
   for key, stack in stacks.items():
     if stack:
       problems.append(f"unclosed span(s) {stack} on pid/tid {key}")
-  for fid, start_i in flows.items():
+  for fid, (start_i, _cat) in flows.items():
     problems.append(f"flow {fid!r} (started at event {start_i}) never "
                     f"terminated with an 'f' phase")
   if problems:

@@ -50,6 +50,17 @@ Request headers map onto scheduler fields (the same admission/deadline
 machinery every other entry point uses — docs/serving.md has the
 table): ``X-Deadline-S`` -> ``deadline_s``, ``X-TTFT-Budget-S`` ->
 ``ttft_budget_s``, ``X-Priority`` -> ``priority``.
+
+Trace context (docs/observability.md "Distributed tracing"): a W3C
+``traceparent`` request header binds the request to the caller's
+trace — its trace-id maps onto the scheduler's ``Request.flow_id``,
+so the flow arc in the merged Perfetto export starts at the HTTP edge
+and the id is recoverable from the caller's trace-id.  When absent,
+one is minted.  A malformed header is a 400 (a proxy that mangles
+trace context should hear about it, not silently fork a new trace).
+The SSE response echoes ``X-Request-Id`` (the request uid) and the
+effective ``traceparent``; ``frontdoor/request`` / ``frontdoor/
+first_byte`` instants give report.py the client-observed TTFT hop.
 """
 
 from __future__ import annotations
@@ -65,10 +76,61 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from easyparallellibrary_tpu.serving.scheduler import Request
+from easyparallellibrary_tpu.observability import trace as trace_lib
+from easyparallellibrary_tpu.serving.scheduler import Request, next_flow_id
 from easyparallellibrary_tpu.utils.logging import get_logger
 
 _PRIORITIES = ("throughput", "latency")
+
+# Perfetto flow ids are JSON numbers; keep them inside the 53-bit
+# exact-integer range so a round-trip through any JSON tooling cannot
+# corrupt the flow binding.
+_FLOW_ID_MASK = (1 << 53) - 1
+
+
+def parse_traceparent(header: str) -> Tuple[str, str, str]:
+  """Strictly parse a W3C ``traceparent`` header
+  (``00-<32hex trace-id>-<16hex parent-id>-<2hex flags>``); returns
+  ``(trace_id, parent_id, flags)`` or raises ``ValueError`` (the front
+  door maps that to a 400)."""
+  parts = header.strip().split("-")
+  if len(parts) != 4:
+    raise ValueError(
+        f"malformed traceparent (want version-traceid-parentid-flags): "
+        f"{header!r}")
+  version, trace_id, parent_id, flags = parts
+  hexdigits = "0123456789abcdef"
+
+  def _hex(field: str, value: str, width: int) -> str:
+    if len(value) != width or any(c not in hexdigits for c in value):
+      raise ValueError(f"malformed traceparent: {field} must be "
+                       f"{width} lowercase hex chars: {value!r}")
+    return value
+
+  _hex("version", version, 2)
+  if version == "ff":
+    raise ValueError("malformed traceparent: version 'ff' is invalid")
+  _hex("trace-id", trace_id, 32)
+  if trace_id == "0" * 32:
+    raise ValueError("malformed traceparent: trace-id must be non-zero")
+  _hex("parent-id", parent_id, 16)
+  if parent_id == "0" * 16:
+    raise ValueError("malformed traceparent: parent-id must be non-zero")
+  _hex("flags", flags, 2)
+  return trace_id, parent_id, flags
+
+
+def mint_traceparent(flow_id: int) -> str:
+  """A fresh ``traceparent`` carrying ``flow_id`` as its trace-id, for
+  requests that arrive without one — the caller can correlate the SSE
+  response's echoed header with the exported trace's flow id."""
+  return f"00-{flow_id:032x}-{flow_id & ((1 << 64) - 1):016x}-01"
+
+
+def flow_id_from_trace_id(trace_id: str) -> int:
+  """Map a 128-bit W3C trace-id onto a Perfetto-safe flow id (low 53
+  bits; collision odds at serving-fleet scale are negligible)."""
+  return int(trace_id, 16) & _FLOW_ID_MASK
 
 
 class _StreamState:
@@ -312,10 +374,20 @@ class FrontDoor:
       self._send_error(h, 404, "unknown path (POST /v1/generate)")
       return
     try:
-      request, prompt_len = self._parse_request(h)
+      request, prompt_len, traceparent = self._parse_request(h)
     except ValueError as e:
       self._send_error(h, 400, str(e))
       return
+    tracer = trace_lib.get_tracer()
+    if tracer.enabled:
+      # Client-arrival mark for the hop breakdown (report.py): the gap
+      # to the router's serving/submit instant is front-door ingress,
+      # the gap from the engine's first token to frontdoor/first_byte
+      # is wire + stream delivery.
+      tracer.instant("frontdoor/request", cat="serving",
+                     track="frontdoor",
+                     args={"uid": str(request.uid),
+                           "flow": int(request.flow_id)})
     stream = _StreamState(request.uid, prompt_len, self.stream_buffer)
     self._commands.put(("submit", request, stream))
     if not stream.admitted.wait(timeout=60.0):
@@ -324,10 +396,10 @@ class FrontDoor:
     if stream.error is not None:
       self._send_error(h, 400, stream.error)
       return
-    self._stream_sse(h, stream)
+    self._stream_sse(h, stream, traceparent)
 
   def _parse_request(self, h: BaseHTTPRequestHandler
-                     ) -> Tuple[Request, int]:
+                     ) -> Tuple[Request, int, str]:
     length = int(h.headers.get("Content-Length", 0) or 0)
     raw = h.rfile.read(length) if length else b""
     try:
@@ -367,6 +439,18 @@ class FrontDoor:
     if priority not in _PRIORITIES:
       raise ValueError(f'priority must be one of {_PRIORITIES}: '
                        f'{priority!r}')
+    # Trace-context propagation: bind the caller's trace-id onto the
+    # request's flow id (mint both when the header is absent), so the
+    # scheduler's flow events — including the child replicas' harvested
+    # ones — connect back to the HTTP edge.
+    header_tp = h.headers.get("traceparent")
+    if header_tp is not None:
+      trace_id, _parent_id, _flags = parse_traceparent(header_tp)
+      flow_id = flow_id_from_trace_id(trace_id) or next_flow_id()
+      traceparent = header_tp.strip()
+    else:
+      flow_id = next_flow_id()
+      traceparent = mint_traceparent(flow_id)
     request = Request(
         uid=uid,
         prompt=np.asarray(prompt, np.int32),
@@ -381,15 +465,23 @@ class FrontDoor:
         seed=_num("field", "seed", body.get("seed"), int, None),
         deadline_s=deadline_s,
         ttft_budget_s=ttft_budget_s,
-        priority=priority)
-    return request, len(prompt)
+        priority=priority,
+        flow_id=flow_id)
+    return request, len(prompt), traceparent
 
   def _stream_sse(self, h: BaseHTTPRequestHandler,
-                  stream: _StreamState) -> None:
+                  stream: _StreamState,
+                  traceparent: Optional[str] = None) -> None:
     h.send_response(200)
     h.send_header("Content-Type", "text/event-stream")
     h.send_header("Cache-Control", "no-store")
     h.send_header("Connection", "close")
+    # Trace-context echo: the uid correlates a client log line with the
+    # trace/report, the traceparent hands back the effective trace-id
+    # (the minted one when the request arrived without).
+    h.send_header("X-Request-Id", str(stream.uid))
+    if traceparent:
+      h.send_header("traceparent", traceparent)
     h.end_headers()
     h.close_connection = True
     # Second backpressure line: a reader whose TCP window stays shut
@@ -398,12 +490,25 @@ class FrontDoor:
     # slow-reader shapes).
     h.connection.settimeout(self.write_timeout_s)
     last_write = time.monotonic()
+    tracer = trace_lib.get_tracer()
+    first_byte_pending = tracer.enabled
+
+    def _mark_first_byte():
+      nonlocal first_byte_pending
+      if first_byte_pending:
+        # Client-observed TTFT endpoint: the first payload frame left
+        # this process (post-flush), everything upstream included.
+        tracer.instant("frontdoor/first_byte", cat="serving",
+                       track="frontdoor", args={"uid": str(stream.uid)})
+        first_byte_pending = False
+
     try:
       while True:
         if stream.final is not None and stream.queue.empty():
           payload = json.dumps(stream.final)
           h.wfile.write(f"event: done\ndata: {payload}\n\n".encode())
           h.wfile.flush()
+          _mark_first_byte()
           return
         try:
           batch = stream.queue.get(timeout=0.05)
@@ -418,6 +523,7 @@ class FrontDoor:
         payload = json.dumps({"tokens": batch})
         h.wfile.write(f"event: token\ndata: {payload}\n\n".encode())
         h.wfile.flush()
+        _mark_first_byte()
         last_write = time.monotonic()
     except (BrokenPipeError, ConnectionResetError, socket.timeout,
             OSError):

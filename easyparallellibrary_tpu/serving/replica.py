@@ -43,6 +43,7 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, List, Optional
 
+from easyparallellibrary_tpu.observability import trace as trace_lib
 from easyparallellibrary_tpu.serving.engine import ContinuousBatchingEngine
 from easyparallellibrary_tpu.serving.scheduler import (
     FinishedRequest, Request)
@@ -230,6 +231,13 @@ class _WorkerServer:
     self.reader = transport_lib.FrameReader(sock)
     self.replica: Optional[EngineReplica] = None
     self._first_tokens: List[Any] = []
+    # Cross-process trace harvest (docs/observability.md "Distributed
+    # tracing"): when the parent's config enables the tracer, this
+    # child records into its OWN ring and the parent drains it in
+    # bounded chunks riding step replies, plus a final flush on the
+    # shutdown/evacuate paths.  0 bytes = harvest off.
+    self.tracer: Optional[Any] = None
+    self._harvest_bytes = 0
     # Idempotency dedup: uid -> recorded reply result.  A submit or
     # restore retried after an ambiguous timeout (the reply was lost
     # AFTER this process applied the call) returns the recorded
@@ -246,7 +254,7 @@ class _WorkerServer:
       compiles = int(rep.engine._step_fn._cache_size())
     except Exception:
       compiles = 0
-    return {
+    beat = {
         "watchdog_timeouts": int(rep.watchdog_timeouts),
         "bad_steps": int(rep.bad_steps),
         "itl_ewma_s": float(rep.itl_ewma_s),
@@ -259,6 +267,13 @@ class _WorkerServer:
         "checkpoint_version": int(rep.checkpoint_version),
         "pid": os.getpid(),
     }
+    if self.tracer is not None and self.tracer.enabled:
+      # The parent pairs this with its send/recv perf_counter_ns stamps
+      # to estimate the cross-process clock offset (midpoint method) —
+      # every reply is a fresh sample, re-sampled on the heartbeat
+      # cadence parent-side.
+      beat["trace_now_us"] = self.tracer.now_us()
+    return beat
 
   def do_init(self, p: Dict[str, Any]) -> Dict[str, Any]:
     wire = int(p.get("wire_version", -1))
@@ -276,6 +291,16 @@ class _WorkerServer:
     import easyparallellibrary_tpu as epl
     config = epl.Config(p.get("config") or {})
     epl.init(config)
+    # The parent's observability config crossed the wire inside the
+    # init frame: configure this child's OWN tracer ring from it, so
+    # child-side spans exist for the parent to harvest.  flow_id rides
+    # every Request snapshot (scheduler wire shape v2+), so the spans
+    # recorded here join the SAME request flow the parent started.
+    tracer = trace_lib.ensure_configured(config)
+    obs = config.observability
+    if tracer.enabled and obs.harvest.enabled:
+      self.tracer = tracer
+      self._harvest_bytes = int(obs.harvest.max_bytes_per_sweep)
     fn, kwargs = self._t.resolve_factory(p["factory"])
     model, params = fn(**kwargs)
     checkpoint = p.get("checkpoint")
@@ -345,8 +370,16 @@ class _WorkerServer:
     # Drain IN PLACE: the scheduler hook holds this exact list object.
     first = list(self._first_tokens)
     self._first_tokens.clear()
-    return {"finished": [self._t.encode_finished(f) for f in fins],
-            "progress": progress, "order": order, "first": first}
+    out = {"finished": [self._t.encode_finished(f) for f in fins],
+           "progress": progress, "order": order, "first": first}
+    if self._harvest_bytes:
+      # Incremental trace harvest piggybacks on the step reply, bounded
+      # bytes per sweep so it can never stall dispatch; the ring
+      # remainder rides later sweeps or the final flush.
+      chunk = self.tracer.drain_wire(self._harvest_bytes)
+      if chunk["events"]:
+        out["trace"] = chunk
+    return out
 
   def do_snapshot(self, p: Dict[str, Any]) -> Dict[str, Any]:
     return {"snaps": self.replica.snapshot_requests()}
@@ -355,7 +388,13 @@ class _WorkerServer:
     snaps = self.replica.evacuate()
     for snap in snaps:
       self._applied.pop(snap["request"]["uid"], None)
-    return {"snaps": snaps}
+    result: Dict[str, Any] = {"snaps": snaps}
+    # A graceful evacuation usually precedes a fence: flush the whole
+    # ring now so a drained replica's spans all reach the merged trace.
+    chunk = self._final_flush()
+    if chunk is not None:
+      result["trace"] = chunk
+    return result
 
   def do_stats(self, p: Dict[str, Any]) -> Dict[str, Any]:
     stats = self.replica.stats
@@ -363,6 +402,31 @@ class _WorkerServer:
 
   def do_ping(self, p: Dict[str, Any]) -> Dict[str, Any]:
     return {"pong": True}
+
+  def do_harvest(self, p: Dict[str, Any]) -> Dict[str, Any]:
+    """Explicit low-priority harvest sweep: drain up to ``max_bytes``
+    of the tracer ring (the configured sweep bound when unspecified;
+    ``drain=True`` empties it)."""
+    if self.tracer is None:
+      return {"done": True}
+    if p.get("drain"):
+      max_bytes = None
+    else:
+      max_bytes = int(p.get("max_bytes") or self._harvest_bytes or 65536)
+    chunk = self.tracer.drain_wire(max_bytes)
+    out: Dict[str, Any] = {"done": not self.tracer.pending}
+    if chunk["events"]:
+      out["trace"] = chunk
+    return out
+
+  def _final_flush(self) -> Optional[Dict[str, Any]]:
+    """The whole ring remainder, for the shutdown/evacuate replies —
+    a cleanly exiting worker loses nothing (the satellite bugfix: child
+    replicas used to exit without exporting a single span)."""
+    if self.tracer is None:
+      return None
+    chunk = self.tracer.drain_wire(None)
+    return chunk if chunk["events"] else None
 
   # ----------------------------------------------------------- serve loop
 
@@ -372,7 +436,7 @@ class _WorkerServer:
         "restore": self.do_restore, "cancel": self.do_cancel,
         "step": self.do_step, "snapshot": self.do_snapshot,
         "evacuate": self.do_evacuate, "stats": self.do_stats,
-        "ping": self.do_ping,
+        "ping": self.do_ping, "harvest": self.do_harvest,
     }
     while True:
       try:
@@ -380,10 +444,28 @@ class _WorkerServer:
       except self._t.ReplicaDeadError:
         # Parent gone (pipe EOF): exit now rather than orphan — the
         # prctl death signal is the backstop, this is the portable path.
+        # Best-effort final trace flush: the socket is usually fully
+        # dead here, but a parent that only shut down its write side
+        # can still receive the ring remainder.
+        chunk = self._final_flush()
+        if chunk is not None:
+          try:
+            self._t.send_frame(self.sock, {
+                "id": None, "m": "trace_flush", "ok": True,
+                "result": {"trace": chunk}, "beat": self._beat()})
+          except OSError:
+            pass
         break
       rid, method = frame.get("id"), frame.get("m")
       if method == "shutdown":
-        self._reply(rid, method, {"ok": True, "result": {}})
+        # Clean exit loses no trace events: the shutdown reply carries
+        # the whole ring remainder (the parent's close() ingests it
+        # before reaping this process).
+        result: Dict[str, Any] = {}
+        chunk = self._final_flush()
+        if chunk is not None:
+          result["trace"] = chunk
+        self._reply(rid, method, {"ok": True, "result": result})
         break
       handler = handlers.get(method)
       try:

@@ -1227,9 +1227,31 @@ class Router:
     records live under ``serving/replica<i>/*`` beside it)."""
     registry.publish(step, self.fleet_summary(), FLEET_NAMESPACE)
 
+  def harvest_traces(self, drain: bool = True) -> int:
+    """Pull every process replica's tracer ring remainder into the
+    ambient tracer (docs/observability.md "Distributed tracing").  The
+    steady-state path needs no call here — bounded chunks ride every
+    step reply, and a clean ``close()`` flushes the rest via the
+    shutdown reply — but a caller exporting the merged trace while the
+    fleet is still up (``make trace-fleet``, the quick pins) drains
+    explicitly first.  Returns events harvested; inproc and injected
+    replicas (no ``harvest`` endpoint) contribute zero."""
+    total = 0
+    for rep in self.replicas:
+      harvest = getattr(rep, "harvest", None)
+      if harvest is None:
+        continue
+      try:
+        total += int(harvest(drain=drain))
+      except TransportError:
+        continue
+    return total
+
   # ----------------------------------------------------------- lifecycle
 
   def close(self):
+    # Process replicas flush their ring remainder on the shutdown
+    # reply, so closing the fleet completes the merged trace.
     for rep in self.replicas:
       rep.close()
 

@@ -83,6 +83,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from easyparallellibrary_tpu.observability import trace as trace_lib
 from easyparallellibrary_tpu.serving.replica import EngineReplica
 from easyparallellibrary_tpu.serving.scheduler import (
     FinishedRequest, Request)
@@ -430,6 +431,21 @@ class ProcessTransport(ReplicaTransport):
     self.rpc_retries_total = 0
     self.rpc_timeouts_total = 0
     self.child_restarts = 0
+    # Cross-process trace harvest + clock alignment (docs/
+    # observability.md "Distributed tracing").  Every reply's beat can
+    # carry the child tracer's clock; paired with the parent-side
+    # send/recv perf_counter_ns stamps per rid it yields an NTP-style
+    # midpoint offset estimate.  The best (smallest-RTT) sample wins
+    # within a heartbeat-cadence resync window.
+    obs = self._config.observability
+    self._harvest_on = bool(obs.enabled and obs.harvest.enabled)
+    self._harvest_final_timeout_s = float(obs.harvest.final_timeout_s)
+    self.trace_events_harvested = 0
+    self._send_ns: Dict[Any, int] = {}
+    self._clock_offset_us: Optional[float] = None
+    self._clock_rtt_ns: Optional[int] = None
+    self._clock_at = 0.0
+    self._clock_resync_s = max(float(rconf.heartbeat_s), 0.1)
     self.last_spawn_s = 0.0     # spawn-to-ready wall time (start())
     self._proc: Optional[subprocess.Popen] = None
     self._sock: Optional[socket.socket] = None
@@ -508,6 +524,12 @@ class ProcessTransport(ReplicaTransport):
     self.exit_signal = None
     self.wire_beat = None
     self._seq = itertools.count(1)
+    # A fresh child is a fresh tracer timebase: the old offset (and the
+    # min-RTT gate that protects it) must not survive a respawn.
+    self._send_ns.clear()
+    self._clock_offset_us = None
+    self._clock_rtt_ns = None
+    self._clock_at = 0.0
     try:
       init_id = self._post("init", {
           "wire_version": WIRE_VERSION,
@@ -525,6 +547,16 @@ class ProcessTransport(ReplicaTransport):
       raise
     info = reply.get("result") or {}
     self.last_spawn_s = time.monotonic() - t_spawn
+    if (self.wire_beat or {}).get("trace_now_us") is not None:
+      # Handshake clock sample: the init reply's RTT spans the whole
+      # engine build (useless for a midpoint estimate), so take one
+      # tight ping now — _ingest pairs its send/recv stamps with the
+      # beat's child clock and seeds the offset.
+      try:
+        self._call("ping", {}, retry=False, condemn=False,
+                   timeout=min(self.rpc_timeout_s, 5.0))
+      except TransportError:
+        pass
     get_logger().info(
         "replica %d: process transport up (pid %d, backend %s, "
         "spawn %.1fs)", self.index, self._proc.pid,
@@ -569,6 +601,15 @@ class ProcessTransport(ReplicaTransport):
       self._sock = None
       self._reader = None
     self._condemned = True
+    # The corpse will never flush again: close whatever spans its
+    # harvested ring left open, at its last rebased timestamp, so the
+    # merged trace stays schema-valid and shows the work ENDING here.
+    pid = self.child_pid
+    if pid is not None:
+      tracer = trace_lib.get_tracer()
+      if tracer.enabled:
+        tracer.close_remote(
+            pid, reason="killed" if self.exit_signal else "lost")
 
   def kill(self, sig: int = _signal.SIGKILL) -> None:
     """Deliver ``sig`` to the child (the chaos harness's real-process
@@ -608,6 +649,12 @@ class ProcessTransport(ReplicaTransport):
       self._mark_dead()
       raise ReplicaDeadError(
           f"replica {self.index}: send failed ({e})") from e
+    # Clock-offset raw material: the reply pairs this send stamp with
+    # its receive stamp (bounded: abandoned rids are evicted oldest
+    # first — their replies will never arrive).
+    self._send_ns[rid] = time.perf_counter_ns()
+    while len(self._send_ns) > 256:
+      self._send_ns.pop(next(iter(self._send_ns)))
     return rid
 
   def _read_frame(self, timeout: Optional[float]) -> Dict[str, Any]:
@@ -659,17 +706,72 @@ class ProcessTransport(ReplicaTransport):
           f"{frame.get('error', '?')}", etype=etype)
     return frame
 
+  def _update_clock(self, send_ns: Optional[int], recv_ns: int,
+                    child_now_us: Optional[float]) -> None:
+    """NTP-style midpoint offset estimate: the child's tracer clock at
+    ``child_now_us`` corresponds to roughly the midpoint of this RPC's
+    send/recv ``perf_counter_ns`` pair, so
+    ``parent_ts ≈ child_ts + offset``.  The error bound is RTT/2:
+    prefer the smallest-RTT sample, re-opening acceptance on the
+    heartbeat cadence (``serving.router.heartbeat_s``) so the estimate
+    tracks long-run drift without letting a step-inflated RTT (the
+    reply that waited on a whole engine step) wreck a tight one."""
+    if send_ns is None or child_now_us is None:
+      return
+    tracer = trace_lib.get_tracer()
+    if not tracer.enabled:
+      return
+    rtt = recv_ns - send_ns
+    now = time.monotonic()
+    stale = now - self._clock_at >= self._clock_resync_s
+    if self._clock_rtt_ns is not None and rtt >= self._clock_rtt_ns \
+        and not (stale and rtt <= 4 * self._clock_rtt_ns):
+      return
+    self._clock_offset_us = (tracer.at_us((send_ns + recv_ns) // 2)
+                             - float(child_now_us))
+    self._clock_rtt_ns = rtt
+    self._clock_at = now
+
+  def _harvest_ingest(self, result: Any) -> None:
+    """Merge a reply's piggybacked trace chunk into the ambient tracer
+    (exactly once — `_ingest` is the single funnel every received frame
+    passes through)."""
+    chunk = result.get("trace") if isinstance(result, dict) else None
+    if not chunk:
+      return
+    tracer = trace_lib.get_tracer()
+    if not tracer.enabled or self._clock_offset_us is None:
+      return
+    pid = self.child_pid or int((self.wire_beat or {}).get("pid") or 0)
+    if not pid:
+      return
+    self.trace_events_harvested += tracer.ingest_remote(
+        pid, chunk.get("events") or (),
+        offset_us=self._clock_offset_us,
+        label=f"replica{self.index} worker (pid {pid})")
+
   def _ingest(self, frame: Dict[str, Any]) -> None:
     """Apply a reply's side-band content exactly once, whether it is
     the awaited reply or a stale one that surfaced while waiting for a
     different id (the lost-reply recovery path: a late step reply still
-    advances the journal watermark and still surfaces its finishes)."""
+    advances the journal watermark and still surfaces its finishes).
+    Side-band now includes the distributed-tracing material: every
+    beat's child-clock sample feeds the offset estimate, and any
+    reply — step piggyback, explicit harvest, evacuate/shutdown final
+    flush, or the worker's unsolicited EOF flush — may carry a trace
+    chunk."""
+    recv_ns = time.perf_counter_ns()
+    send_ns = self._send_ns.pop(frame.get("id"), None)
     beat = frame.get("beat")
     if beat:
       self.wire_beat = beat
-    if frame.get("m") != "step" or not frame.get("ok", False):
+      self._update_clock(send_ns, recv_ns, beat.get("trace_now_us"))
+    if not frame.get("ok", False):
       return
     result = frame.get("result") or {}
+    self._harvest_ingest(result)
+    if frame.get("m") != "step":
+      return
     for uid, start, tokens in result.get("progress", ()):
       entry = self._journal.get(uid)
       if entry is None:
@@ -898,7 +1000,32 @@ class ProcessTransport(ReplicaTransport):
   def rpc_counters(self) -> Dict[str, int]:
     return {"rpc_retries": int(self.rpc_retries_total),
             "rpc_timeouts": int(self.rpc_timeouts_total),
-            "child_restarts": int(self.child_restarts)}
+            "child_restarts": int(self.child_restarts),
+            "trace_events_harvested": int(self.trace_events_harvested)}
+
+  def harvest(self, drain: bool = True) -> int:
+    """Pull the child's tracer ring into the ambient tracer via the
+    explicit low-priority ``harvest`` RPC (each reply stays within the
+    configured sweep byte bound; ``drain=True`` loops until the ring is
+    dry or ``observability.harvest.final_timeout_s`` elapses).  Best
+    effort: a deadline miss is an observability gap, never a death
+    sentence for a healthy replica.  Returns the events harvested."""
+    if not self.alive or not self._harvest_on:
+      return 0
+    before = self.trace_events_harvested
+    deadline = time.monotonic() + self._harvest_final_timeout_s
+    while True:
+      try:
+        reply = self._call("harvest", {}, retry=False, condemn=False,
+                           timeout=min(self.rpc_timeout_s, 5.0))
+      except TransportError:
+        break
+      result = reply.get("result") or {}
+      if not drain or result.get("done") or not result.get("trace"):
+        break
+      if time.monotonic() >= deadline:
+        break
+    return self.trace_events_harvested - before
 
   @property
   def stats(self):

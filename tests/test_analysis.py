@@ -323,6 +323,45 @@ def test_host_sync_covers_frontdoor_and_reactor_modules(tmp_path):
   assert [f.line for f in findings] == [8]
 
 
+def test_lint_covers_distributed_tracing_hot_paths(tmp_path):
+  """The cross-process harvest path (ISSUE 20) is hot-path for
+  epl-lint: the SHIPPED observability/trace.py scans as hot (drain_wire
+  runs inside the worker serve loop and ingest_remote inside the
+  parent's reply funnel — an implicit device->host fetch a future edit
+  introduces there is a finding, and the shipped baseline stays empty;
+  the quick zero-findings acceptance below enforces that).  The
+  lock-discipline twin mirrors the Tracer's harvest accounting: state
+  written under ``_lock`` in the drain path must never be written
+  unlocked elsewhere."""
+  from easyparallellibrary_tpu.analysis.core import ModuleInfo
+  from easyparallellibrary_tpu.analysis.rules import _is_hot
+  pkg = package_root()
+  for rel in ("observability/trace.py", "serving/transport.py"):
+    shipped = os.path.join(pkg, rel)
+    assert os.path.exists(shipped)
+    assert _is_hot(ModuleInfo(path=shipped, rel=rel, source="",
+                              tree=None, parse_error=None)), rel
+  path = _write(tmp_path, "observability/trace.py", """\
+      import threading
+
+
+      class Harvest:
+        def __init__(self):
+          self._lock = threading.Lock()
+          self._n_drained = 0
+
+        def drain_wire(self):
+          with self._lock:
+            self._n_drained += 1
+
+        def clear(self):
+          self._n_drained = 0
+      """)
+  findings = _by_rule(_run(path), "lock-discipline")
+  assert [f.line for f in findings] == [14]
+  assert "'_n_drained'" in findings[0].message
+
+
 def test_host_sync_flags_implicit_bool_and_float(tmp_path):
   _write(tmp_path, "runtime/loop.py", """\
       def fit(step_fn, state, batch):

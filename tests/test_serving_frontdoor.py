@@ -479,3 +479,103 @@ def test_chaos_frontdoor_sigstop_hang_under_reactor_heals():
     assert _wait_for(lambda: router.failovers >= 1, timeout_s=60.0), \
         "the frozen child must be condemned and failed over"
   router.close()
+
+
+# ------------------------------------ trace-context propagation (W3C)
+
+
+def test_traceparent_parse_and_mint_units():
+  """Strict W3C parsing: a valid header decomposes, a minted header
+  round-trips, and every malformed shape is a ValueError (the 400
+  path) — never a silently broken trace."""
+  from easyparallellibrary_tpu.serving.frontdoor.server import (
+      flow_id_from_trace_id, mint_traceparent, parse_traceparent)
+  tid, pid, flags = parse_traceparent(
+      "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01")
+  assert tid == "4bf92f3577b34da6a3ce929d0e0e4736"
+  assert pid == "00f067aa0ba902b7" and flags == "01"
+  # flow_id keeps the trace-id's low 53 bits (exact as a JSON number).
+  assert flow_id_from_trace_id(tid) == int(tid, 16) & ((1 << 53) - 1)
+  minted = mint_traceparent(12345)
+  tid2, _, _ = parse_traceparent(minted)
+  assert flow_id_from_trace_id(tid2) == 12345
+  for bad in [
+      "",                                                  # empty
+      "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7",  # 3 parts
+      "zz-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01",
+      "ff-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01",
+      "00-4bf92f3577b34da6-00f067aa0ba902b7-01",           # short tid
+      "00-4BF92F3577B34DA6A3CE929D0E0E4736-00f067aa0ba902b7-01",
+      "00-" + "0" * 32 + "-00f067aa0ba902b7-01",           # zero tid
+      "00-4bf92f3577b34da6a3ce929d0e0e4736-" + "0" * 16 + "-01",
+      "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-0g",
+  ]:
+    with pytest.raises(ValueError):
+      parse_traceparent(bad)
+
+
+def test_traceparent_propagation_echo_and_400_over_socket():
+  """Over the real socket: a caller's ``traceparent`` maps onto the
+  submitted Request's flow_id and is echoed back verbatim beside
+  ``X-Request-Id``; an absent header gets a minted one carrying the
+  flow id; a malformed header is a 400, not a broken trace."""
+  from easyparallellibrary_tpu.serving.frontdoor.client import _post
+  from easyparallellibrary_tpu.serving.frontdoor.server import (
+      flow_id_from_trace_id, parse_traceparent)
+
+  class FakeRouter:
+    def __init__(self):
+      self.on_tokens = []
+      self.finished = {}
+      self.captured = []
+      self.has_work = False
+
+    def submit(self, request):
+      self.captured.append(request)
+      self.finished[request.uid] = FinishedRequest(
+          uid=request.uid, tokens=np.asarray(request.prompt, np.int32),
+          new_tokens=0, finish_reason="shed")
+      return False
+
+    def cancel(self, uid):
+      return False
+
+    def step(self):
+      return []
+
+    def states(self):
+      return ["healthy"]
+
+  header = "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"
+  want_flow = flow_id_from_trace_id("4bf92f3577b34da6a3ce929d0e0e4736")
+  router = FakeRouter()
+  with FrontDoor(router, config=_config(reactor=False)) as fd:
+    resp = _post(fd.address, {"uid": "tp-1", "prompt": [1, 2, 3],
+                              "max_new_tokens": 2},
+                 {"traceparent": header}, timeout=30.0)
+    assert resp.status == 200
+    assert resp.getheader("X-Request-Id") == "tp-1"
+    assert resp.getheader("traceparent") == header
+    resp.read()
+    resp.close()
+    (req,) = router.captured
+    assert req.flow_id == want_flow
+
+    # Absent header: the front door mints one carrying the flow id it
+    # assigned, so the caller can still join its logs to the trace.
+    resp = _post(fd.address, {"uid": "tp-2", "prompt": [4, 5],
+                              "max_new_tokens": 2}, None, timeout=30.0)
+    assert resp.status == 200
+    minted = resp.getheader("traceparent")
+    resp.read()
+    resp.close()
+    tid, _, _ = parse_traceparent(minted)
+    assert flow_id_from_trace_id(tid) == router.captured[-1].flow_id
+    assert router.captured[-1].flow_id  # really minted, non-zero
+
+    for bad in ["garbage", "00-dead-beef-01",
+                "ff-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"]:
+      with pytest.raises(RuntimeError, match="HTTP 400"):
+        list(stream_generate(fd.address, {"prompt": [1]},
+                             headers={"traceparent": bad}))
+    assert len(router.captured) == 2, "malformed headers never submit"
